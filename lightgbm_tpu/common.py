@@ -1,0 +1,20 @@
+"""Shared framework-wide constants.
+
+Counterpart of include/LightGBM/meta.h: the missing-value type enum
+(bin.h:27-31) and the zero threshold (meta.h:56) used consistently by binning,
+tree decisions, and device inference.
+"""
+
+MISSING_NONE = 0  # MissingType::None
+MISSING_ZERO = 1  # MissingType::Zero
+MISSING_NAN = 2  # MissingType::NaN
+
+K_ZERO_THRESHOLD = 1e-35  # meta.h:56 kZeroThreshold
+
+K_EPSILON = 1e-15  # meta.h kEpsilon
+K_MIN_SCORE = -float("inf")
+
+
+def round_int(x: float) -> int:
+    """Round half away from zero (Common::RoundInt / std::lround semantics)."""
+    return int(x + 0.5) if x >= 0 else -int(-x + 0.5)

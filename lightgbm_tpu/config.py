@@ -1,0 +1,316 @@
+"""Typed parameter system with full alias resolution.
+
+TPU-native counterpart of the reference Config (include/LightGBM/config.h:40,
+src/io/config.cpp, generated src/io/config_auto.cpp). The parameter universe —
+names, types, defaults, aliases, range checks — lives in `_param_spec.py`,
+extracted mechanically from the reference's config.h doc-comments exactly as the
+reference's own `.ci/parameter-generator.py` does, so the public parameter API
+matches the reference parameter-for-parameter.
+
+Key behaviors reproduced:
+  * alias → canonical-name mapping (ParameterAlias::KeyAliasTransform,
+    config.cpp:101); first-occurrence-wins on duplicates; `verbosity` takes the
+    minimum of duplicates like the reference does for conflicting values.
+  * objective / metric family aliases (ParseObjectiveAlias /
+    ParseMetricAlias, config.h:1274-1329).
+  * `Config.set(params)` type coercion + range checks (config_auto.cpp
+    GetMembersFromString).
+  * `config.to_string()` — the `parameters:` section of the model file
+    (Config::SaveMembersToString).
+  * key=value / config-file parsing (KV2Map, application.cpp:53-89).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple, Union
+
+from ._param_spec import PARAM_SPEC
+from .utils.log import Log
+
+# canonical name -> (pytype, default, aliases, checks, no_save)
+_SPEC: Dict[str, Tuple[str, Any, List[str], List[str], bool]] = {
+    name: (ptype, default, aliases, checks, no_save)
+    for name, ptype, default, aliases, checks, no_save in PARAM_SPEC
+}
+
+# alias (and canonical) -> canonical
+_ALIAS: Dict[str, str] = {}
+for _name, (_t, _d, _aliases, _c, _ns) in _SPEC.items():
+    _ALIAS[_name] = _name
+    for _a in _aliases:
+        _ALIAS.setdefault(_a, _name)
+
+# Objective aliases — reference config.h:1274-1299 (ParseObjectiveAlias)
+_OBJECTIVE_ALIAS = {}
+for _canon, _names in [
+    ("regression", ["regression", "regression_l2", "mean_squared_error", "mse", "l2",
+                    "l2_root", "root_mean_squared_error", "rmse"]),
+    ("regression_l1", ["regression_l1", "mean_absolute_error", "l1", "mae"]),
+    ("multiclass", ["multiclass", "softmax"]),
+    ("multiclassova", ["multiclassova", "multiclass_ova", "ova", "ovr"]),
+    ("cross_entropy", ["xentropy", "cross_entropy"]),
+    ("cross_entropy_lambda", ["xentlambda", "cross_entropy_lambda"]),
+    ("mape", ["mean_absolute_percentage_error", "mape"]),
+    ("rank_xendcg", ["rank_xendcg", "xendcg", "xe_ndcg", "xe_ndcg_mart", "xendcg_mart"]),
+    ("custom", ["none", "null", "custom", "na"]),
+]:
+    for _n in _names:
+        _OBJECTIVE_ALIAS[_n] = _canon
+
+# Metric aliases — reference config.h:1301-1329 (ParseMetricAlias)
+_METRIC_ALIAS = {}
+for _canon, _names in [
+    ("l2", ["regression", "regression_l2", "l2", "mean_squared_error", "mse"]),
+    ("rmse", ["l2_root", "root_mean_squared_error", "rmse"]),
+    ("l1", ["regression_l1", "l1", "mean_absolute_error", "mae"]),
+    ("binary_logloss", ["binary_logloss", "binary"]),
+    ("ndcg", ["ndcg", "lambdarank", "rank_xendcg", "xendcg", "xe_ndcg", "xe_ndcg_mart",
+              "xendcg_mart"]),
+    ("map", ["map", "mean_average_precision"]),
+    ("multi_logloss", ["multi_logloss", "multiclass", "softmax", "multiclassova",
+                       "multiclass_ova", "ova", "ovr"]),
+    ("cross_entropy", ["xentropy", "cross_entropy"]),
+    ("cross_entropy_lambda", ["xentlambda", "cross_entropy_lambda"]),
+    ("kullback_leibler", ["kldiv", "kullback_leibler"]),
+    ("mape", ["mean_absolute_percentage_error", "mape"]),
+    ("custom", ["none", "null", "custom", "na"]),
+]:
+    for _n in _names:
+        _METRIC_ALIAS[_n] = _canon
+
+
+def parse_objective_alias(name: str) -> str:
+    return _OBJECTIVE_ALIAS.get(name, name)
+
+
+def parse_metric_alias(name: str) -> str:
+    return _METRIC_ALIAS.get(name, name)
+
+
+def _coerce(name: str, ptype: str, value: Any) -> Any:
+    if isinstance(value, str):
+        v = value.strip()
+        if ptype == "str":
+            return v
+        if ptype == "bool":
+            if v.lower() in ("true", "1", "+", "yes"):
+                return True
+            if v.lower() in ("false", "0", "-", "no"):
+                return False
+            Log.fatal("Parameter %s should be of type bool, got \"%s\"", name, v)
+        if ptype == "int":
+            return int(float(v))
+        if ptype == "float":
+            return float(v)
+        if ptype.startswith("list"):
+            if not v:
+                return []
+            items = [x for x in v.replace(";", ",").split(",") if x != ""]
+            if ptype == "list_int":
+                return [int(float(x)) for x in items]
+            if ptype == "list_float":
+                return [float(x) for x in items]
+            return items
+    if ptype == "bool":
+        return bool(value)
+    if ptype == "int":
+        return int(value)
+    if ptype == "float":
+        return float(value)
+    if ptype == "str":
+        return str(value)
+    if ptype.startswith("list"):
+        seq = list(value) if isinstance(value, (list, tuple)) else [value]
+        if ptype == "list_int":
+            return [int(x) for x in seq]
+        if ptype == "list_float":
+            return [float(x) for x in seq]
+        return [str(x) for x in seq]
+    return value
+
+
+def _check(name: str, value: Any, checks: List[str]) -> None:
+    if not checks or not isinstance(value, (int, float)) or isinstance(value, bool):
+        return
+    for chk in checks:
+        op = "".join(c for c in chk if c in "<>=!")
+        num = float(chk.replace(op, ""))
+        ok = {
+            ">": value > num,
+            ">=": value >= num,
+            "<": value < num,
+            "<=": value <= num,
+        }.get(op, True)
+        if not ok:
+            Log.fatal("Check failed: %s %s for parameter %s=%s", name, chk, name, value)
+
+
+def key_alias_transform(params: Mapping[str, Any]) -> Dict[str, Any]:
+    """Resolve aliases to canonical parameter names.
+
+    Mirrors ParameterAlias::KeyAliasTransform: when both an alias and the
+    canonical name (or two aliases) are present, the canonical name wins,
+    otherwise the first alias in spec order; a warning is emitted for ignored
+    duplicates. Unknown keys pass through untouched (the reference keeps them
+    for pluggable parsers / custom objectives).
+    """
+    out: Dict[str, Any] = {}
+    chosen_src: Dict[str, str] = {}
+    for key, value in params.items():
+        canonical = _ALIAS.get(key, key)
+        if canonical not in out:
+            out[canonical] = value
+            chosen_src[canonical] = key
+            continue
+        if canonical == "verbosity":
+            # reference special case: conflicting verbosity resolves to the
+            # minimum (most silent wins)
+            out[canonical] = min(int(out[canonical]), int(value))
+            continue
+        # duplicate: canonical key itself has priority
+        if key == canonical and chosen_src[canonical] != canonical:
+            Log.warning("%s is set with %s=%s, %s=%s will be ignored. Current value: %s=%s",
+                        canonical, key, value, chosen_src[canonical], out[canonical],
+                        canonical, value)
+            out[canonical] = value
+            chosen_src[canonical] = key
+        else:
+            Log.warning("%s is set=%s, %s=%s will be ignored. Current value: %s=%s",
+                        chosen_src[canonical], out[canonical], key, value,
+                        canonical, out[canonical])
+    return out
+
+
+def kv2map(args: Iterable[str]) -> Dict[str, str]:
+    """Parse `key=value` tokens (CLI/config-file lines) — reference KV2Map."""
+    out: Dict[str, str] = {}
+    for arg in args:
+        arg = arg.strip()
+        if not arg or arg.startswith("#"):
+            continue
+        if "=" not in arg:
+            continue
+        key, value = arg.split("=", 1)
+        key = key.strip()
+        value = value.split("#", 1)[0].strip()
+        if key in out:
+            if _ALIAS.get(key, key) == "verbosity":
+                # duplicate verbosity resolves to the minimum (config.cpp)
+                try:
+                    out[key] = str(min(int(out[key]), int(value)))
+                except ValueError:
+                    pass
+            continue  # otherwise first occurrence wins
+        out[key] = value
+    return out
+
+
+class Config:
+    """All training/prediction parameters as attributes.
+
+    `Config()` gives reference defaults; `Config(params_dict)` applies
+    overrides with alias resolution, coercion, and checks.
+    """
+
+    def __init__(self, params: Optional[Mapping[str, Any]] = None) -> None:
+        for name, (_ptype, default, _aliases, _checks, _ns) in _SPEC.items():
+            setattr(self, name, copy.copy(default))
+        # derived / non-spec state
+        self.raw_params: Dict[str, Any] = {}
+        self.metric: List[str] = []
+        if params:
+            self.set(params)
+
+    def set(self, params: Mapping[str, Any]) -> None:
+        params = key_alias_transform(dict(params))
+        self.raw_params.update(params)
+        # objective family alias
+        if "objective" in params:
+            params = dict(params)
+            params["objective"] = parse_objective_alias(str(params["objective"]))
+        # metric parsing (GetMetricType config.cpp:158-167): explicit metric list,
+        # else derived from objective
+        metric_value = params.pop("metric", None) if isinstance(params, dict) else None
+        for name, value in params.items():
+            if name not in _SPEC:
+                continue  # unknown keys tolerated (custom parsers etc.)
+            ptype, _default, _aliases, checks, _ns = _SPEC[name]
+            coerced = _coerce(name, ptype, value)
+            _check(name, coerced, checks)
+            setattr(self, name, coerced)
+        if metric_value is not None:
+            if isinstance(metric_value, str):
+                names = [m for m in metric_value.replace(";", ",").split(",") if m]
+            else:
+                names = list(metric_value)
+            self.metric = []
+            for m in names:
+                canon = parse_metric_alias(m.strip())
+                if canon and canon not in self.metric:
+                    self.metric.append(canon)
+        # an empty metric (unset, or explicitly "") derives from the objective
+        # (GetMetricType, config.cpp:158-167)
+        if not self.metric and self.objective:
+            derived = parse_metric_alias(self.objective)
+            self.metric = [] if derived == "custom" else [derived]
+        self._post_process()
+
+    def _post_process(self) -> None:
+        # mirrors Config::CheckParamConflict essentials
+        if self.is_unbalance and self.scale_pos_weight != 1.0:
+            Log.fatal("Cannot set both is_unbalance and scale_pos_weight, choose only one of them")
+        if self.boosting == "goss":  # legacy spelling → gbdt + goss strategy
+            self.boosting = "gbdt"
+            self.data_sample_strategy = "goss"
+        if self.bagging_freq > 0 and (self.bagging_fraction >= 1.0 and self.neg_bagging_fraction >= 1.0
+                                      and self.pos_bagging_fraction >= 1.0):
+            self.bagging_freq = 0
+        # reference clamps num_leaves from max_depth only when the user did not
+        # set num_leaves explicitly (config.cpp CheckParamConflict)
+        if self.max_depth > 0 and "num_leaves" not in self.raw_params:
+            self.num_leaves = min(self.num_leaves, (1 << self.max_depth))
+
+    def to_string(self) -> str:
+        """Model-file `parameters:` section — Config::SaveMembersToString format.
+
+        Parameters tagged [no-save] in the reference spec (IO paths, task
+        selection, prediction-time options) are excluded, matching
+        config_auto.cpp's generated SaveMembersToString.
+        """
+        lines = []
+        for name, (ptype, default, _aliases, _checks, no_save) in _SPEC.items():
+            if no_save:
+                continue
+            value = getattr(self, name)
+            if ptype.startswith("list"):
+                sval = ",".join(str(x) for x in value)
+            elif ptype == "bool":
+                sval = "1" if value else "0"
+            else:
+                sval = str(value)
+            lines.append(f"[{name}: {sval}]")
+        return "\n".join(lines)
+
+    def clone(self) -> "Config":
+        return copy.deepcopy(self)
+
+    @staticmethod
+    def param_names() -> List[str]:
+        return list(_SPEC.keys())
+
+    @staticmethod
+    def aliases() -> Dict[str, str]:
+        return dict(_ALIAS)
+
+
+def load_config_file(path: str) -> Dict[str, str]:
+    """Read a reference-format train.conf (key = value lines, # comments)."""
+    kvs: Dict[str, str] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            key, value = line.split("=", 1)
+            kvs.setdefault(key.strip(), value.strip())
+    return kvs

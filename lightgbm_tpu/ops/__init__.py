@@ -1,0 +1,3 @@
+from .predict import PackedEnsemble, pack_ensemble, predict_raw
+
+__all__ = ["PackedEnsemble", "pack_ensemble", "predict_raw"]

@@ -1,0 +1,202 @@
+"""Vectorized tree-ensemble inference on TPU.
+
+TPU-native replacement for the reference's per-row recursive traversal
+(Tree::Predict / NumericalDecision, include/LightGBM/tree.h:338-420, and
+GBDT::PredictRaw, src/boosting/gbdt_prediction.cpp:15-56). Instead of
+pointer-chasing per row, all trees are packed into padded [T, nodes] tensors
+and traversed with a depth-synchronous gather loop under jit: every row of
+every tree advances one level per step; rows that reached a leaf (negative
+node id) freeze. This keeps shapes static and the whole ensemble evaluation a
+single fused XLA computation, vmapped over trees.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import MISSING_NAN, MISSING_ZERO, K_ZERO_THRESHOLD
+from ..models.tree import Tree
+
+_EPS = K_ZERO_THRESHOLD
+
+
+@dataclass
+class PackedEnsemble:
+    """Device-resident padded arrays for a list of trees.
+
+    Shapes: T = number of trees, I = max internal nodes, L = max leaves,
+    W = total categorical bitset words (>=1).
+    """
+
+    split_feature: jax.Array  # [T, I] int32
+    threshold: jax.Array  # [T, I] float
+    decision_type: jax.Array  # [T, I] int32
+    left_child: jax.Array  # [T, I] int32
+    right_child: jax.Array  # [T, I] int32
+    leaf_value: jax.Array  # [T, L] float
+    cat_words: jax.Array  # [W] uint32 bitset words (real-value space)
+    cat_offset: jax.Array  # [T, I] int32 word offset for categorical nodes
+    cat_n_words: jax.Array  # [T, I] int32
+    num_leaves: jax.Array  # [T] int32
+    max_depth: int
+    num_trees: int
+
+    def tree_slice(self, start: int, end: int) -> "PackedEnsemble":
+        return PackedEnsemble(
+            split_feature=self.split_feature[start:end],
+            threshold=self.threshold[start:end],
+            decision_type=self.decision_type[start:end],
+            left_child=self.left_child[start:end],
+            right_child=self.right_child[start:end],
+            leaf_value=self.leaf_value[start:end],
+            cat_words=self.cat_words,
+            cat_offset=self.cat_offset[start:end],
+            cat_n_words=self.cat_n_words[start:end],
+            num_leaves=self.num_leaves[start:end],
+            max_depth=self.max_depth,
+            num_trees=end - start,
+        )
+
+
+jax.tree_util.register_pytree_node(
+    PackedEnsemble,
+    lambda p: ((p.split_feature, p.threshold, p.decision_type, p.left_child,
+                p.right_child, p.leaf_value, p.cat_words, p.cat_offset,
+                p.cat_n_words, p.num_leaves), (p.max_depth, p.num_trees)),
+    lambda aux, ch: PackedEnsemble(*ch, max_depth=aux[0], num_trees=aux[1]),
+)
+
+
+def pack_ensemble(trees: Sequence[Tree], dtype=jnp.float32) -> PackedEnsemble:
+    """Pack host Tree objects into padded device tensors."""
+    T = max(len(trees), 1)
+    I = max(max((t.num_leaves - 1 for t in trees), default=1), 1)
+    L = max(max((t.num_leaves for t in trees), default=1), 1)
+    sf = np.zeros((T, I), dtype=np.int32)
+    th = np.zeros((T, I), dtype=np.float64)
+    dt = np.zeros((T, I), dtype=np.int32)
+    lc = np.full((T, I), -1, dtype=np.int32)
+    rc = np.full((T, I), -1, dtype=np.int32)
+    lv = np.zeros((T, L), dtype=np.float64)
+    nl = np.ones(T, dtype=np.int32)
+    co = np.zeros((T, I), dtype=np.int32)
+    cw_n = np.zeros((T, I), dtype=np.int32)
+    cat_words: List[int] = []
+    max_depth = 1
+    for k, tree in enumerate(trees):
+        ni = tree.num_leaves - 1
+        nl[k] = tree.num_leaves
+        if ni > 0:
+            sf[k, :ni] = tree.split_feature[:ni]
+            th[k, :ni] = tree.threshold[:ni]
+            dt[k, :ni] = tree.decision_type[:ni].astype(np.int32) & 0xFF
+            lc[k, :ni] = tree.left_child[:ni]
+            rc[k, :ni] = tree.right_child[:ni]
+            max_depth = max(max_depth, tree.max_depth)
+            for node in range(ni):
+                if dt[k, node] & 1:  # categorical
+                    cat_idx = int(tree.threshold[node])
+                    lo, hi = tree.cat_boundaries[cat_idx], tree.cat_boundaries[cat_idx + 1]
+                    co[k, node] = len(cat_words)
+                    cw_n[k, node] = hi - lo
+                    cat_words.extend(tree.cat_threshold[lo:hi])
+        lv[k, : tree.num_leaves] = tree.leaf_value[: tree.num_leaves]
+    if not cat_words:
+        cat_words = [0]
+    # float64 thresholds only take effect with jax x64 enabled; otherwise
+    # jnp.asarray would silently round-to-nearest down to f32, so route through
+    # the decision-preserving round-toward--inf downcast instead.
+    f64_effective = dtype == jnp.float64 and jax.config.jax_enable_x64
+    if not f64_effective:
+        # Round thresholds toward -inf when downcasting: for any float32 x,
+        # (x <= t64) == (x <= rounddown32(t64)), so device decisions over
+        # float32 inputs exactly match the float64 reference semantics.
+        th32 = th.astype(np.float32)
+        over = th32.astype(np.float64) > th
+        th32[over] = np.nextafter(th32[over], -np.inf)
+        th = th32
+    return PackedEnsemble(
+        split_feature=jnp.asarray(sf),
+        threshold=jnp.asarray(th, dtype=jnp.float64 if f64_effective else jnp.float32),
+        decision_type=jnp.asarray(dt),
+        left_child=jnp.asarray(lc),
+        right_child=jnp.asarray(rc),
+        leaf_value=jnp.asarray(lv, dtype=dtype),
+        cat_words=jnp.asarray(np.array(cat_words, dtype=np.uint32)),
+        cat_offset=jnp.asarray(co),
+        cat_n_words=jnp.asarray(cw_n),
+        num_leaves=jnp.asarray(nl),
+        max_depth=int(max_depth),
+        num_trees=len(trees),
+    )
+
+
+def _tree_leaf_index(packed: PackedEnsemble, tree_idx, X: jax.Array, max_depth: int):
+    """Leaf index [N] for one tree over row-major X [N, F]."""
+    sf = packed.split_feature[tree_idx]
+    th = packed.threshold[tree_idx]
+    dt = packed.decision_type[tree_idx]
+    lc = packed.left_child[tree_idx]
+    rc = packed.right_child[tree_idx]
+    co = packed.cat_offset[tree_idx]
+    cn = packed.cat_n_words[tree_idx]
+    n = X.shape[0]
+    single_leaf = packed.num_leaves[tree_idx] <= 1
+
+    def body(_, node):
+        active = node >= 0
+        nd = jnp.maximum(node, 0)
+        feat = sf[nd]
+        fval = jnp.take_along_axis(X, feat[:, None], axis=1)[:, 0]
+        d = dt[nd]
+        is_cat = (d & 1) > 0
+        default_left = (d & 2) > 0
+        missing_type = (d >> 2) & 3
+        # --- numerical decision (tree.h:338-355)
+        is_nan = jnp.isnan(fval)
+        fval_num = jnp.where(is_nan & (missing_type != MISSING_NAN), 0.0, fval)
+        is_missing = ((missing_type == MISSING_ZERO) & (jnp.abs(fval_num) <= _EPS)) | (
+            (missing_type == MISSING_NAN) & jnp.isnan(fval_num))
+        go_left_num = jnp.where(is_missing, default_left, fval_num <= th[nd])
+        # --- categorical decision (tree.h:375-388)
+        int_fval = jnp.where(is_nan, -1, fval.astype(jnp.int32))
+        word_idx = jnp.clip(int_fval, 0, None) // 32
+        bit_idx = jnp.clip(int_fval, 0, None) % 32
+        in_range = (int_fval >= 0) & (word_idx < cn[nd])
+        word = packed.cat_words[jnp.clip(co[nd] + word_idx, 0, packed.cat_words.shape[0] - 1)]
+        go_left_cat = in_range & (((word >> bit_idx.astype(jnp.uint32)) & 1) > 0)
+        go_left = jnp.where(is_cat, go_left_cat, go_left_num)
+        nxt = jnp.where(go_left, lc[nd], rc[nd])
+        return jnp.where(active, nxt, node)
+
+    node0 = jnp.zeros(n, dtype=jnp.int32)
+    node = jax.lax.fori_loop(0, max_depth, body, node0)
+    leaf = jnp.where(single_leaf, 0, ~node)
+    return leaf
+
+
+def predict_leaf_indices(packed: PackedEnsemble, X: jax.Array) -> jax.Array:
+    """[N, T] leaf index per row per tree."""
+    T = packed.num_trees
+    leaf_fn = jax.vmap(lambda k: _tree_leaf_index(packed, k, X, packed.max_depth))
+    return leaf_fn(jnp.arange(T)).T
+
+
+def predict_raw(packed: PackedEnsemble, X: jax.Array, num_tree_per_iteration: int = 1) -> jax.Array:
+    """Raw scores [N, num_tree_per_iteration] summed over iterations."""
+    T = packed.num_trees
+    if T == 0:
+        return jnp.zeros((X.shape[0], num_tree_per_iteration), dtype=X.dtype)
+
+    def tree_score(k):
+        leaf = _tree_leaf_index(packed, k, X, packed.max_depth)
+        return packed.leaf_value[k][leaf]
+
+    scores = jax.vmap(tree_score)(jnp.arange(T))  # [T, N]
+    scores = scores.reshape(T // num_tree_per_iteration, num_tree_per_iteration, X.shape[0])
+    return scores.sum(axis=0).T  # [N, C]

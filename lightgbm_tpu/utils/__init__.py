@@ -1,0 +1,4 @@
+from .log import Log, register_log_callback
+from .timer import global_timer, timed
+
+__all__ = ["Log", "register_log_callback", "global_timer", "timed"]

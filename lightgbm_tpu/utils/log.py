@@ -1,0 +1,65 @@
+"""Logging with levels + redirectable callback.
+
+TPU-native counterpart of the reference's Log class
+(include/LightGBM/utils/log.h:78-180): four levels (Fatal/Warning/Info/Debug),
+a process-wide verbosity, and a registerable output callback (the reference
+exposes this through LGBM_RegisterLogCallback, c_api.h:73).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Callable, Optional
+
+_state = threading.local()
+
+
+class LightGBMError(Exception):
+    """Raised by Log.fatal — mirrors the reference's LightGBMException."""
+
+
+def _default_writer(msg: str) -> None:
+    sys.stdout.write(msg)
+    sys.stdout.flush()
+
+
+_callback: Optional[Callable[[str], None]] = None
+_verbosity = 1  # matches config `verbosity` default: <0 fatal, 0 warn, 1 info, >1 debug
+
+
+def register_log_callback(cb: Optional[Callable[[str], None]]) -> None:
+    global _callback
+    _callback = cb
+
+
+def set_verbosity(level: int) -> None:
+    global _verbosity
+    _verbosity = level
+
+
+class Log:
+    @staticmethod
+    def _write(level_str: str, msg: str) -> None:
+        out = f"[LightGBM-TPU] [{level_str}] {msg}\n"
+        (_callback or _default_writer)(out)
+
+    @staticmethod
+    def debug(msg: str, *args) -> None:
+        if _verbosity > 1:
+            Log._write("Debug", msg % args if args else msg)
+
+    @staticmethod
+    def info(msg: str, *args) -> None:
+        if _verbosity >= 1:
+            Log._write("Info", msg % args if args else msg)
+
+    @staticmethod
+    def warning(msg: str, *args) -> None:
+        if _verbosity >= 0:
+            Log._write("Warning", msg % args if args else msg)
+
+    @staticmethod
+    def fatal(msg: str, *args) -> None:
+        text = msg % args if args else msg
+        Log._write("Fatal", text)
+        raise LightGBMError(text)

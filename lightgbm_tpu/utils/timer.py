@@ -1,0 +1,66 @@
+"""Per-label accumulating wall-clock timer.
+
+Counterpart of the reference's Common::Timer/FunctionTimer RAII scopes
+(include/LightGBM/utils/common.h:979-1063) that feed `global_timer`, printed
+at exit under -DUSE_TIMETAG. Here: a context-manager / decorator that
+accumulates per-label seconds, plus jax.profiler trace annotation so the same
+labels appear in TPU traces.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Dict, Iterator
+
+
+class GlobalTimer:
+    def __init__(self) -> None:
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+        self.enabled = bool(os.environ.get("LGBM_TPU_TIMETAG"))
+
+    @contextlib.contextmanager
+    def scope(self, label: str) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        try:
+            import jax.profiler
+
+            ctx = jax.profiler.TraceAnnotation(label)
+        except Exception:  # pragma: no cover - profiler unavailable
+            ctx = contextlib.nullcontext()
+        start = time.perf_counter()
+        with ctx:
+            yield
+        self.totals[label] += time.perf_counter() - start
+        self.counts[label] += 1
+
+    def report(self) -> str:
+        lines = ["LightGBM-TPU timer summary:"]
+        for label in sorted(self.totals, key=self.totals.get, reverse=True):
+            lines.append(f"  {label}: {self.totals[label]:.3f}s ({self.counts[label]} calls)")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()
+
+
+global_timer = GlobalTimer()
+
+
+def timed(label: str):
+    """Decorator form of global_timer.scope."""
+
+    def deco(fn):
+        def wrapper(*args, **kwargs):
+            with global_timer.scope(label):
+                return fn(*args, **kwargs)
+
+        wrapper.__name__ = getattr(fn, "__name__", "timed")
+        return wrapper
+
+    return deco

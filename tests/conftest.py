@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the reference's DistributedMockup strategy (tests/distributed/
+_test_distributed.py) of exercising the real collective path on one machine:
+here `xla_force_host_platform_device_count=8` gives 8 XLA CPU devices so
+shard_map/pjit collective code paths run exactly as they would across a TPU
+slice.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The hosted-TPU (axon) plugin force-selects itself via
+# jax.config.update("jax_platforms", "axon,cpu") in sitecustomize, overriding
+# the JAX_PLATFORMS env var. Tests must run on the virtual 8-device CPU mesh,
+# so override it back before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

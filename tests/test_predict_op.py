@@ -1,0 +1,89 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.models.tree import Tree, MISSING_NONE, MISSING_NAN
+from lightgbm_tpu.ops.predict import pack_ensemble, predict_raw, predict_leaf_indices
+from tests.test_tree import make_simple_tree
+
+
+def test_packed_matches_host_predict(rng):
+    trees = [make_simple_tree() for _ in range(3)]
+    trees[1].shrink(0.5)
+    packed = pack_ensemble(trees)
+    X = rng.uniform(-1, 5, size=(64, 2)).astype(np.float32)
+    out = np.asarray(predict_raw(packed, jnp.asarray(X)))
+    expected = np.array([[sum(t.predict(row) for t in trees)] for row in X])
+    np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+
+def test_packed_handles_nan_and_categorical(rng):
+    t = Tree(max_leaves=3)
+    right = t.split(leaf=0, feature_inner=0, real_feature=0, threshold_bin=1,
+                    threshold_double=0.5, default_left=True, missing_type=MISSING_NAN,
+                    gain=1.0, left_value=-1.0, right_value=1.0, left_count=1, right_count=1,
+                    left_weight=1.0, right_weight=1.0, parent_value=0.0)
+    t.split_categorical(leaf=right, feature_inner=1, real_feature=1,
+                        bin_bitset=[0b110], value_bitset=[0b110],
+                        missing_type=MISSING_NONE, gain=1.0,
+                        left_value=5.0, right_value=7.0, left_count=1, right_count=1,
+                        left_weight=1.0, right_weight=1.0, parent_value=1.0)
+    packed = pack_ensemble([t])
+    X = np.array([
+        [np.nan, 0.0],   # nan -> default left -> -1
+        [1.0, 1.0],      # right, cat 1 in {1,2} -> 5
+        [1.0, 2.0],      # -> 5
+        [1.0, 3.0],      # -> 7
+        [1.0, np.nan],   # cat nan -> right -> 7
+    ], dtype=np.float32)
+    out = np.asarray(predict_raw(packed, jnp.asarray(X)))[:, 0]
+    np.testing.assert_allclose(out, [-1.0, 5.0, 5.0, 7.0, 7.0])
+    host = np.array([t.predict(row) for row in X])
+    np.testing.assert_allclose(out, host)
+
+
+def test_multiclass_grouping(rng):
+    # 2 iterations x 2 classes = 4 trees; class k sums trees k, k+2
+    trees = []
+    for v in (1.0, 10.0, 100.0, 1000.0):
+        t = Tree(max_leaves=2)
+        t.split(leaf=0, feature_inner=0, real_feature=0, threshold_bin=1,
+                threshold_double=0.5, default_left=False, missing_type=MISSING_NONE,
+                gain=1.0, left_value=v, right_value=-v, left_count=1, right_count=1,
+                left_weight=1.0, right_weight=1.0, parent_value=0.0)
+        trees.append(t)
+    packed = pack_ensemble(trees)
+    X = np.array([[0.0], [1.0]], dtype=np.float32)
+    out = np.asarray(predict_raw(packed, jnp.asarray(X), num_tree_per_iteration=2))
+    np.testing.assert_allclose(out, [[101.0, 1010.0], [-101.0, -1010.0]])
+
+
+def test_leaf_indices(rng):
+    trees = [make_simple_tree()]
+    packed = pack_ensemble(trees)
+    X = np.array([[0.0, 0.0], [1.0, 2.0], [1.0, 3.0]], dtype=np.float32)
+    leaves = np.asarray(predict_leaf_indices(packed, jnp.asarray(X)))
+    assert leaves[:, 0].tolist() == [0, 1, 2]
+
+
+def test_stump_only_model():
+    t = Tree(max_leaves=2)
+    t.as_constant_tree(0.25)
+    packed = pack_ensemble([t])
+    X = np.zeros((4, 1), dtype=np.float32)
+    out = np.asarray(predict_raw(packed, jnp.asarray(X)))
+    np.testing.assert_allclose(out, 0.25)
+
+
+def test_threshold_downcast_preserves_f32_decisions():
+    import math
+    # threshold not representable in f32, just above a representable value
+    x = np.float32(1.0000001)
+    t64 = float(x) + 1e-12  # x <= t64 in f64
+    tree = Tree(max_leaves=2)
+    tree.split(0, 0, 0, 1, t64, False, MISSING_NONE, 1.0, -1.0, 1.0, 1, 1, 1.0, 1.0, 0.0)
+    packed = pack_ensemble([tree])
+    X = jnp.asarray(np.array([[x], [np.nextafter(x, np.float32(2.0))]], dtype=np.float32))
+    out = np.asarray(predict_raw(packed, X))[:, 0]
+    assert out[0] == -1.0  # x <= t64 -> left, preserved after downcast
+    assert out[1] == 1.0

@@ -1,0 +1,154 @@
+import math
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.models.tree import Tree, MISSING_NONE, MISSING_NAN, MISSING_ZERO
+from lightgbm_tpu.models.serialize import GBDTModel
+
+
+def make_simple_tree():
+    """f0 <= 0.5 -> leaf0(-1.0); else f1 <= 2.5 -> leaf1(2.0) else leaf2(3.0)."""
+    t = Tree(max_leaves=4)
+    right = t.split(leaf=0, feature_inner=0, real_feature=0, threshold_bin=1,
+                    threshold_double=0.5, default_left=False, missing_type=MISSING_NONE,
+                    gain=10.0, left_value=-1.0, right_value=1.5, left_count=5, right_count=5,
+                    left_weight=5.0, right_weight=5.0, parent_value=0.0)
+    t.split(leaf=right, feature_inner=1, real_feature=1, threshold_bin=2,
+            threshold_double=2.5, default_left=False, missing_type=MISSING_NONE,
+            gain=4.0, left_value=2.0, right_value=3.0, left_count=3, right_count=2,
+            left_weight=3.0, right_weight=2.0, parent_value=1.5)
+    return t
+
+
+def test_tree_predict():
+    t = make_simple_tree()
+    assert t.num_leaves == 3
+    assert t.predict(np.array([0.0, 0.0])) == -1.0
+    assert t.predict(np.array([1.0, 2.0])) == 2.0
+    assert t.predict(np.array([1.0, 3.0])) == 3.0
+
+
+def test_missing_nan_default_direction():
+    t = Tree(max_leaves=2)
+    t.split(leaf=0, feature_inner=0, real_feature=0, threshold_bin=1,
+            threshold_double=0.5, default_left=True, missing_type=MISSING_NAN,
+            gain=1.0, left_value=-1.0, right_value=1.0, left_count=1, right_count=1,
+            left_weight=1.0, right_weight=1.0, parent_value=0.0)
+    assert t.predict(np.array([float("nan")])) == -1.0
+    assert t.predict(np.array([0.7])) == 1.0
+    # NaN with missing_type None is treated as 0.0 (tree.h:339-341)
+    t2 = Tree(max_leaves=2)
+    t2.split(leaf=0, feature_inner=0, real_feature=0, threshold_bin=1,
+             threshold_double=0.5, default_left=False, missing_type=MISSING_NONE,
+             gain=1.0, left_value=-1.0, right_value=1.0, left_count=1, right_count=1,
+             left_weight=1.0, right_weight=1.0, parent_value=0.0)
+    assert t2.predict(np.array([float("nan")])) == -1.0
+
+
+def test_zero_as_missing():
+    t = Tree(max_leaves=2)
+    t.split(leaf=0, feature_inner=0, real_feature=0, threshold_bin=1,
+            threshold_double=-5.0, default_left=False, missing_type=MISSING_ZERO,
+            gain=1.0, left_value=-1.0, right_value=1.0, left_count=1, right_count=1,
+            left_weight=1.0, right_weight=1.0, parent_value=0.0)
+    # zero goes to default (right) even though 0 > -5 would anyway; use default_left
+    t2 = Tree(max_leaves=2)
+    t2.split(leaf=0, feature_inner=0, real_feature=0, threshold_bin=1,
+             threshold_double=5.0, default_left=False, missing_type=MISSING_ZERO,
+             gain=1.0, left_value=-1.0, right_value=1.0, left_count=1, right_count=1,
+             left_weight=1.0, right_weight=1.0, parent_value=0.0)
+    assert t2.predict(np.array([0.0])) == 1.0  # zero -> default right despite 0 <= 5
+    assert t2.predict(np.array([1.0])) == -1.0
+
+
+def test_categorical_split():
+    t = Tree(max_leaves=2)
+    bitset = [0b1010]  # categories {1, 3} go left
+    t.split_categorical(leaf=0, feature_inner=0, real_feature=0,
+                        bin_bitset=bitset, value_bitset=bitset,
+                        missing_type=MISSING_NONE, gain=1.0,
+                        left_value=-2.0, right_value=2.0, left_count=1, right_count=1,
+                        left_weight=1.0, right_weight=1.0, parent_value=0.0)
+    assert t.predict(np.array([1.0])) == -2.0
+    assert t.predict(np.array([3.0])) == -2.0
+    assert t.predict(np.array([2.0])) == 2.0
+    assert t.predict(np.array([float("nan")])) == 2.0
+    assert t.predict(np.array([-1.0])) == 2.0
+    assert t.predict(np.array([64.0])) == 2.0  # out of bitset range -> right
+
+
+def test_shrinkage():
+    t = make_simple_tree()
+    t.shrink(0.1)
+    assert t.predict(np.array([0.0, 0.0])) == pytest.approx(-0.1)
+    assert t.shrinkage == pytest.approx(0.1)
+
+
+def test_text_roundtrip():
+    t = make_simple_tree()
+    t.shrink(0.1)
+    s = t.to_string()
+    assert s.startswith("num_leaves=3")
+    kv = {}
+    for line in s.split("\n"):
+        if "=" in line:
+            k, v = line.split("=", 1)
+            kv[k] = v
+    t2 = Tree.from_key_values(kv)
+    assert t2.num_leaves == 3
+    for row in ([0.0, 0.0], [1.0, 2.0], [1.0, 3.0], [0.5, 2.5]):
+        assert t2.predict(np.array(row)) == pytest.approx(t.predict(np.array(row)))
+
+
+def test_model_roundtrip():
+    model = GBDTModel()
+    model.num_class = 1
+    model.num_tree_per_iteration = 1
+    model.max_feature_idx = 1
+    model.objective_str = "binary sigmoid:1"
+    model.feature_names = ["Column_0", "Column_1"]
+    model.feature_infos = ["[0:1]", "[0:5]"]
+    model.trees = [make_simple_tree(), make_simple_tree()]
+    model.trees[1].shrink(0.1)
+    text = model.to_string()
+    assert text.startswith("tree\nversion=v4\n")
+    assert "end of trees" in text
+
+    model2 = GBDTModel.from_string(text)
+    assert model2.num_class == 1
+    assert model2.max_feature_idx == 1
+    assert model2.objective_str == "binary sigmoid:1"
+    assert len(model2.trees) == 2
+    row = np.array([1.0, 2.0])
+    expected = model.trees[0].predict(row) + model.trees[1].predict(row)
+    got = model2.trees[0].predict(row) + model2.trees[1].predict(row)
+    assert got == pytest.approx(expected)
+    # re-serialize identical
+    assert model2.to_string() == text
+
+
+def test_feature_importance():
+    model = GBDTModel()
+    model.max_feature_idx = 1
+    model.feature_names = ["a", "b"]
+    model.feature_infos = ["[0:1]", "[0:5]"]
+    model.trees = [make_simple_tree()]
+    imp = model.feature_importance("split")
+    assert imp.tolist() == [1.0, 1.0]
+    gain = model.feature_importance("gain")
+    assert gain[0] == pytest.approx(10.0)
+
+
+def test_json_dump():
+    import json
+
+    model = GBDTModel()
+    model.max_feature_idx = 1
+    model.feature_names = ["a", "b"]
+    model.feature_infos = ["[0:1]", "[0:5]"]
+    model.trees = [make_simple_tree()]
+    d = json.loads(model.dump_json())
+    assert d["num_class"] == 1
+    assert d["tree_info"][0]["num_leaves"] == 3
+    assert d["tree_info"][0]["tree_structure"]["split_feature"] == 0

@@ -1,0 +1,93 @@
+"""Extract the LightGBM parameter spec (names, types, defaults, aliases,
+checks, no-save markers) from the reference's config.h doc-comments into a
+Python literal.
+
+This mirrors what the reference's own .ci/parameter-generator.py does for
+config_auto.cpp: the doc-comments in include/LightGBM/config.h are the single
+source of truth for the parameter API surface. We emit
+lightgbm_tpu/_param_spec.py.
+"""
+import re
+
+src = open('/root/reference/include/LightGBM/config.h').read()
+lines = src.split('\n')
+
+params = []
+comments = []
+in_params = False
+depth = 0
+for line in lines:
+    s = line.strip()
+    if s.startswith('#pragma region'):
+        depth += 1
+        if 'Parameters' in s and depth == 1:
+            in_params = True
+        continue
+    if s.startswith('#pragma endregion'):
+        depth -= 1
+        if depth == 0:
+            in_params = False
+        continue
+    if not in_params:
+        continue
+    if s.startswith('//'):
+        comments.append(s[2:].strip())
+        continue
+    m = re.match(
+        r'(std::string|std::vector<std::string>|std::vector<double>|std::vector<int>|'
+        r'std::vector<int8_t>|double|float|int|int64_t|size_t|bool|data_size_t)\s+(\w+)\s*(?:=\s*(.*?))?;\s*$',
+        s)
+    if m:
+        ctype, name, default = m.groups()
+        meta = {'name': name, 'ctype': ctype, 'default': default,
+                'aliases': [], 'checks': [], 'no_save': False}
+        for c in comments:
+            if c.startswith('alias'):
+                meta['aliases'] = [a.strip() for a in c.split('=', 1)[1].split(',')]
+            elif c.startswith('check'):
+                meta['checks'].append(c.split('=', 1)[1].strip())
+            elif c == '[no-save]':
+                meta['no_save'] = True
+        params.append(meta)
+        comments = []
+    elif s:
+        comments = []
+
+PYTYPE = {'std::string': 'str', 'std::vector<std::string>': 'list_str',
+          'std::vector<double>': 'list_float', 'std::vector<int>': 'list_int',
+          'std::vector<int8_t>': 'list_int', 'double': 'float', 'float': 'float',
+          'int': 'int', 'int64_t': 'int', 'size_t': 'int', 'bool': 'bool',
+          'data_size_t': 'int'}
+SYMBOLIC = {'kDefaultNumLeaves': 31, 'size_t(10) * 1024 * 1024 * 1024': 10737418240}
+
+
+def pydefault(p):
+    d = p['default']
+    t = PYTYPE[p['ctype']]
+    if d is None:
+        return '' if t == 'str' else ([] if t.startswith('list') else (False if t == 'bool' else 0))
+    if d in SYMBOLIC:
+        return SYMBOLIC[d]
+    if t == 'str':
+        return d.strip('"')
+    if t.startswith('list'):
+        return []
+    if t == 'bool':
+        return d == 'true'
+    if t == 'int':
+        return int(float(d.rstrip('f')))
+    if t == 'float':
+        return float(d.rstrip('f'))
+    return d
+
+
+out = ['# Parameter spec extracted from the reference config doc-comments',
+       '# (include/LightGBM/config.h) by tools/extract_param_spec.py.',
+       '# Fields: (name, pytype, default, aliases, checks, no_save)',
+       'PARAM_SPEC = [']
+for p in params:
+    out.append('    (%r, %r, %r, %r, %r, %r),' % (
+        p['name'], PYTYPE[p['ctype']], pydefault(p), p['aliases'], p['checks'], p['no_save']))
+out.append(']')
+open('/root/repo/lightgbm_tpu/_param_spec.py', 'w').write('\n'.join(out) + '\n')
+print('extracted', len(params), 'params;', sum(p['no_save'] for p in params), 'no-save')
