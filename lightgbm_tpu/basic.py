@@ -1,0 +1,458 @@
+"""lightgbm-compatible Dataset and Booster.
+
+Counterpart of python-package/lightgbm/basic.py (Dataset :1773, Booster :3581):
+the user-facing objects with lazy Dataset construction, reference alignment
+for validation data, and the Booster train/predict/save surface. Where the
+reference binds to the C API through ctypes, this implementation drives the
+in-process training engine (models/gbdt.py) directly — the C-API-shaped
+boundary is preserved in naming and behavior so code written against lightgbm
+ports over unchanged.
+"""
+from __future__ import annotations
+
+import copy
+import json
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config, key_alias_transform, parse_objective_alias
+from .io.dataset import Dataset as _CoreDataset
+from .io.parser import (load_positions, load_query_boundaries, load_weights,
+                        parse_file)
+from .models.gbdt import GBDT
+from .models.serialize import GBDTModel
+from .objectives import create_objective
+from .utils.log import Log, LightGBMError
+
+_NUMERIC_TYPES = (int, float, bool)
+
+
+def _to_2d_float(data) -> np.ndarray:
+    if hasattr(data, "toarray"):  # scipy sparse
+        data = data.toarray()
+    if hasattr(data, "values") and not isinstance(data, np.ndarray):  # DataFrame
+        data = data.values
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(1, -1)
+    return arr
+
+
+class Dataset:
+    """Lazy-constructed training dataset (basic.py:1773)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True, position=None) -> None:
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.position = position
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = copy.deepcopy(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self._handle: Optional[_CoreDataset] = None
+        self._raw: Optional[np.ndarray] = None
+        self.used_indices: Optional[np.ndarray] = None
+        self._predictor = None
+        self.pandas_categorical = None
+
+    # ------------------------------------------------------------ construction
+
+    def construct(self) -> "Dataset":
+        if self._handle is not None:
+            return self
+        params = dict(self.params)
+        config = Config(params)
+        data = self.data
+        label = self.label
+        feature_names: Optional[List[str]] = None
+
+        if isinstance(data, (str, Path)):
+            X, y, names = parse_file(
+                str(data), header=config.header,
+                label_column=config.label_column or "0")
+            if label is None:
+                label = y
+            feature_names = names
+            w = load_weights(str(data))
+            if w is not None and self.weight is None:
+                self.weight = w
+            q = load_query_boundaries(str(data))
+            if q is not None and self.group is None:
+                self.group = q
+            p = load_positions(str(data))
+            if p is not None and self.position is None:
+                self.position = p
+        else:
+            X = _to_2d_float(data)
+            if (self.feature_name == "auto" and hasattr(data, "columns")):
+                feature_names = [str(c) for c in data.columns]
+
+        if isinstance(self.feature_name, (list, tuple)):
+            feature_names = list(self.feature_name)
+
+        cats: List[int] = []
+        if isinstance(self.categorical_feature, (list, tuple)):
+            for c in self.categorical_feature:
+                if isinstance(c, str) and feature_names and c in feature_names:
+                    cats.append(feature_names.index(c))
+                elif isinstance(c, _NUMERIC_TYPES):
+                    cats.append(int(c))
+        if "categorical_feature" in params or "categorical_column" in params:
+            raw = params.get("categorical_feature", params.get("categorical_column"))
+            if isinstance(raw, str):
+                for tok in raw.split(","):
+                    tok = tok.strip()
+                    if tok.startswith("name:") and feature_names:
+                        for nm in tok[5:].split(","):
+                            if nm in feature_names:
+                                cats.append(feature_names.index(nm))
+                    elif tok:
+                        cats.append(int(tok))
+
+        ref_handle = None
+        if self.reference is not None:
+            self.reference.construct()
+            ref_handle = self.reference._handle
+
+        if self.used_indices is not None:
+            X = X[self.used_indices]
+            label = (np.asarray(label)[self.used_indices]
+                     if label is not None else None)
+
+        self._handle = _CoreDataset.from_matrix(
+            X, label=label, weight=self.weight, group=self.group,
+            init_score=self.init_score, position=self.position,
+            config=config, categorical_feature=cats,
+            feature_names=feature_names, reference=ref_handle)
+        if config.monotone_constraints:
+            self._handle.monotone_constraints = list(config.monotone_constraints)
+        self._raw = np.asarray(X, dtype=np.float32)
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    # ---------------------------------------------------------------- helpers
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None, position=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params or self.params, position=position)
+
+    def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
+        self.construct()
+        sub = Dataset(None, params=params or self.params,
+                      free_raw_data=self.free_raw_data)
+        sub._handle = self._handle.subset(np.asarray(used_indices))
+        sub._raw = self._raw[np.asarray(used_indices)] if self._raw is not None else None
+        sub.reference = self
+        return sub
+
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._handle is not None and label is not None:
+            self._handle.metadata.set_label(label)
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._handle is not None:
+            self._handle.metadata.set_weights(weight)
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._handle is not None and group is not None:
+            self._handle.metadata.set_query(group)
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._handle is not None:
+            self._handle.metadata.set_init_score(init_score)
+        return self
+
+    def set_position(self, position) -> "Dataset":
+        self.position = position
+        if self._handle is not None:
+            self._handle.metadata.set_positions(position)
+        return self
+
+    def get_label(self):
+        if self._handle is not None and self._handle.metadata.label is not None:
+            return self._handle.metadata.label
+        return self.label
+
+    def get_weight(self):
+        return self.weight
+
+    def get_group(self):
+        return self.group
+
+    def get_init_score(self):
+        return self.init_score
+
+    def get_data(self):
+        return self.data if self.data is not None else self._raw
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self._handle.feature_names)
+
+    def num_data(self) -> int:
+        self.construct()
+        return self._handle.num_data
+
+    def num_feature(self) -> int:
+        self.construct()
+        return self._handle.num_total_features
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Binary dataset cache (Dataset::SaveBinaryFile analog, npz-based)."""
+        self.construct()
+        h = self._handle
+        np.savez_compressed(
+            filename, bins=h.bins,
+            label=h.metadata.label if h.metadata.label is not None else [],
+            mappers=json.dumps([m.to_dict() for m in h.mappers]),
+            feature_names=json.dumps(h.feature_names),
+            group_lists=json.dumps([g.feature_indices for g in h.groups]),
+            raw=self._raw if self._raw is not None else [])
+        return self
+
+
+class Booster:
+    """Training/prediction handle (basic.py:3581)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None) -> None:
+        self.params = dict(params) if params else {}
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._train_data_name = "training"
+        self.name_valid_sets: List[str] = []
+
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError(f"Training data should be Dataset instance, "
+                                f"met {type(train_set).__name__}")
+            train_set.params = {**train_set.params, **self.params}
+            train_set.construct()
+            self.config = Config(self.params)
+            objective = create_objective(self.config.objective, self.config)
+            self._gbdt = GBDT(self.config, train_set._handle, objective,
+                              train_raw=train_set._raw)
+            self.train_set = train_set
+            self._model: Optional[GBDTModel] = None
+        elif model_file is not None or model_str is not None:
+            model = (GBDTModel.from_file(model_file) if model_file
+                     else GBDTModel.from_string(model_str))
+            self._model = model
+            self.config = Config(self.params)
+            self._gbdt = GBDT(self.config, None, None)
+            self._gbdt.models = model.trees
+            self._gbdt.num_class = model.num_class
+            self._gbdt.num_tree_per_iteration = model.num_tree_per_iteration
+            self._gbdt.objective = _objective_from_string(model.objective_str, self.config)
+            self.train_set = None
+            self.pandas_categorical = None
+        else:
+            raise TypeError("Need at least one training dataset or model "
+                            "file or model string to create Booster instance")
+
+    # ------------------------------------------------------------------ train
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        if not isinstance(data, Dataset):
+            raise TypeError(f"Validation data should be Dataset instance, "
+                            f"met {type(data).__name__}")
+        data.construct()
+        self._gbdt.add_valid(data._handle, data._raw, name)
+        self.name_valid_sets.append(name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; returns True if training finished
+        (basic.py:4091 / LGBM_BoosterUpdateOneIter)."""
+        if fobj is not None:
+            if self._gbdt.objective is not None:
+                raise LightGBMError("Cannot use fobj with a built-in objective; "
+                                    "set objective='none'")
+            grad, hess = fobj(self.__pred_for_fobj(), self.train_set)
+            return self.__boost(grad, hess)
+        return self._gbdt.train_one_iter()
+
+    def __pred_for_fobj(self):
+        score = np.asarray(self._gbdt.score)
+        return score.ravel() if score.shape[0] == 1 else score.T
+
+    def __boost(self, grad, hess) -> bool:
+        grad = np.asarray(grad, dtype=np.float32)
+        hess = np.asarray(hess, dtype=np.float32)
+        return self._gbdt.train_one_iter(grad, hess)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self._gbdt.iter_
+
+    def num_trees(self) -> int:
+        return len(self._gbdt.models)
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_tree_per_iteration
+
+    def num_feature(self) -> int:
+        if self.train_set is not None:
+            return self.train_set.num_feature()
+        return self._model.max_feature_idx + 1 if self._model else 0
+
+    def feature_name(self) -> List[str]:
+        if self.train_set is not None:
+            return self.train_set.get_feature_name()
+        return list(self._model.feature_names) if self._model else []
+
+    # ------------------------------------------------------------------- eval
+
+    def eval_train(self, feval=None) -> List:
+        return self.__format_eval(self._gbdt.eval_train(), feval, "train")
+
+    def eval_valid(self, feval=None) -> List:
+        return self.__format_eval(self._gbdt.eval_valid(), feval, "valid")
+
+    def __format_eval(self, results, feval, which) -> List:
+        out = [(dname, mname, val, bigger) for dname, mname, val, bigger in results]
+        if feval is not None:
+            fevals = feval if isinstance(feval, (list, tuple)) else [feval]
+            for fe in fevals:
+                if which == "train" and self.train_set is not None:
+                    res = fe(self.__pred_for_feval(self.train_set), self.train_set)
+                    name, val, bigger = res
+                    out.append((self._train_data_name, name, val, bigger))
+        return out
+
+    def __pred_for_feval(self, dataset):
+        score = np.asarray(self._gbdt.score)
+        return score.ravel() if score.shape[0] == 1 else score.T
+
+    # ---------------------------------------------------------------- predict
+
+    def predict(self, data, start_iteration: int = 0, num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, validate_features: bool = False,
+                **kwargs) -> np.ndarray:
+        X = _to_2d_float(data).astype(np.float32)
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else 0
+        if pred_leaf:
+            return self._gbdt.predict_leaf_index(X, num_iteration)
+        if pred_contrib:
+            from .shap import predict_contrib
+
+            return predict_contrib(self._gbdt.models, X,
+                                   self._gbdt.num_tree_per_iteration,
+                                   num_iteration)
+        return self._gbdt.predict(X, raw_score=raw_score, num_iteration=num_iteration)
+
+    # ------------------------------------------------------------------ model
+
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> "Booster":
+        model = self.__get_model()
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        model.save_to_file(str(filename), start_iteration, num_iteration or -1,
+                           importance_type)
+        return self
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        model = self.__get_model()
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        return model.to_string(start_iteration, num_iteration or -1, importance_type)
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> Dict:
+        model = self.__get_model()
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        return json.loads(model.dump_json(start_iteration, num_iteration or -1,
+                                          importance_type))
+
+    def __get_model(self) -> GBDTModel:
+        if self.train_set is not None:
+            model = self._gbdt.to_model()
+            model.best_iteration = self.best_iteration
+            return model
+        return self._model
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        model = self.__get_model()
+        imp = model.feature_importance(importance_type, iteration or 0)
+        return imp if importance_type == "gain" else imp.astype(np.int64)
+
+    def lower_bound(self):
+        vals = [t.leaf_value[: t.num_leaves].min() for t in self._gbdt.models]
+        return float(np.sum(vals)) if vals else 0.0
+
+    def upper_bound(self):
+        vals = [t.leaf_value[: t.num_leaves].max() for t in self._gbdt.models]
+        return float(np.sum(vals)) if vals else 0.0
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        self.params.update(params)
+        self.config.set(params)
+        if self.train_set is not None:
+            self._gbdt.shrinkage_rate = self.config.learning_rate
+            self._gbdt.tree_learner.config = self.config
+            self._gbdt.tree_learner.params_dev = _learner_params(self.config)
+        return self
+
+
+def _learner_params(config: Config):
+    import jax.numpy as jnp
+
+    return jnp.asarray([
+        config.lambda_l1, config.lambda_l2, float(config.min_data_in_leaf),
+        config.min_sum_hessian_in_leaf, config.min_gain_to_split,
+        config.max_delta_step], dtype=jnp.float32)
+
+
+def _objective_from_string(objective_str: Optional[str], config: Config):
+    """Rebuild an objective from a model file's `objective=` line
+    (e.g. 'binary sigmoid:1', 'multiclass num_class:3')."""
+    if not objective_str:
+        return None
+    parts = objective_str.split()
+    name = parse_objective_alias(parts[0])
+    for tok in parts[1:]:
+        if ":" in tok:
+            k, v = tok.split(":", 1)
+            try:
+                config.set({k: v})
+            except Exception:
+                pass
+    try:
+        return create_objective(name, config)
+    except LightGBMError:
+        return None

@@ -1,0 +1,120 @@
+"""Command-line application: train / predict / convert_model / refit /
+save_binary driven by reference-format config files.
+
+Counterpart of src/main.cpp + src/application/application.cpp: accepts the
+same `key=value` arguments and `config=train.conf` files as the reference CLI
+so reference example configs run unchanged:
+
+    python -m lightgbm_tpu.cli config=examples/binary_classification/train.conf
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .config import Config, key_alias_transform, kv2map, load_config_file
+from .engine import train as train_fn
+from .utils.log import Log, set_verbosity
+
+
+def _parse_args(argv: List[str]) -> Dict[str, str]:
+    kvs = kv2map(argv)
+    if "config" in kvs:
+        file_kvs = load_config_file(kvs["config"])
+        for k, v in file_kvs.items():
+            kvs.setdefault(k, v)
+    return kvs
+
+
+def run(argv: List[str]) -> int:
+    kvs = _parse_args(argv)
+    params = key_alias_transform(kvs)
+    task = params.pop("task", "train")
+    config = Config(params)
+    set_verbosity(config.verbosity)
+
+    if task == "train":
+        return _task_train(config, params)
+    if task in ("predict", "prediction", "test"):
+        return _task_predict(config, params)
+    if task == "convert_model":
+        return _task_convert(config, params)
+    if task == "refit":
+        Log.fatal("Task refit is not yet supported in the TPU CLI")
+    if task == "save_binary":
+        ds = Dataset(config.data, params=params)
+        ds.construct()
+        ds.save_binary((config.data or "train") + ".bin")
+        return 0
+    Log.fatal("Unknown task type %s", task)
+    return 1
+
+
+def _task_train(config: Config, params: Dict[str, str]) -> int:
+    if not config.data:
+        Log.fatal("No training data, please set data=... in the config")
+    train_ds = Dataset(config.data, params=params)
+    valid_sets = []
+    valid_names = []
+    valid_paths = config.valid if isinstance(config.valid, list) else (
+        [v for v in str(config.valid).split(",") if v])
+    for i, vp in enumerate(valid_paths):
+        valid_sets.append(Dataset(vp, reference=train_ds, params=params))
+        valid_names.append(f"valid_{i + 1}")
+    callbacks = [callback_mod.log_evaluation(period=max(config.metric_freq, 1))]
+    booster = train_fn(params, train_ds, num_boost_round=config.num_iterations,
+                       valid_sets=valid_sets or None,
+                       valid_names=valid_names or None,
+                       callbacks=callbacks)
+    out = config.output_model or "LightGBM_model.txt"
+    booster.save_model(out)
+    Log.info("Finished training, model saved to %s", out)
+    return 0
+
+
+def _task_predict(config: Config, params: Dict[str, str]) -> int:
+    if not config.input_model:
+        Log.fatal("No input model, please set input_model=...")
+    booster = Booster(model_file=config.input_model, params=params)
+    data_path = config.data
+    from .io.parser import parse_file
+
+    X, _, _ = parse_file(data_path, header=config.header,
+                         label_column=config.label_column or "0")
+    pred = booster.predict(
+        X, raw_score=config.predict_raw_score,
+        pred_leaf=config.predict_leaf_index,
+        pred_contrib=config.predict_contrib,
+        num_iteration=config.num_iteration_predict
+        if config.num_iteration_predict > 0 else None)
+    out = config.output_result or "LightGBM_predict_result.txt"
+    np.savetxt(out, np.asarray(pred), fmt="%.9g",
+               delimiter="\t" if np.ndim(pred) > 1 else "\n")
+    Log.info("Finished prediction, results saved to %s", out)
+    return 0
+
+
+def _task_convert(config: Config, params: Dict[str, str]) -> int:
+    from .models.serialize import GBDTModel
+
+    if not config.input_model:
+        Log.fatal("No input model, please set input_model=...")
+    model = GBDTModel.from_file(config.input_model)
+    out = config.convert_model or "gbdt_prediction.cpp"
+    if config.convert_model_language in ("", "cpp"):
+        with open(out, "w") as fh:
+            fh.write(model.dump_json())
+        Log.info("Model converted (JSON form) to %s", out)
+    return 0
+
+
+def main() -> None:
+    sys.exit(run(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from .registry import create_metric, Metric, METRIC_REGISTRY
+
+__all__ = ["create_metric", "Metric", "METRIC_REGISTRY"]

@@ -1,0 +1,96 @@
+"""Binary metrics — counterpart of src/metric/binary_metric.hpp: logloss,
+error rate, AUC, average precision. AUC/AP are device sort-based (jnp.argsort
+then a weighted rank accumulation) — the analog of the reference's sorted-scan
+(binary_metric.hpp AUCMetric::Eval)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import Metric, register_metric
+
+
+@register_metric("binary_logloss", "binary")
+class BinaryLoglossMetric(Metric):
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        self._is_pos = jnp.asarray((metadata.label > 0).astype(np.float32))
+        self._w = (jnp.asarray(metadata.weights) if metadata.weights is not None else None)
+        self._sumw = (float(np.sum(metadata.weights)) if metadata.weights is not None
+                      else float(num_data))
+
+    def eval(self, score, objective):
+        prob = objective.convert_output(score) if objective is not None else score
+        eps = 1e-15
+        prob = jnp.clip(prob, eps, 1.0 - eps)
+        loss = -(self._is_pos * jnp.log(prob) + (1.0 - self._is_pos) * jnp.log(1.0 - prob))
+        if self._w is not None:
+            loss = loss * self._w
+        return [float(jnp.sum(loss)) / self._sumw]
+
+
+@register_metric("binary_error")
+class BinaryErrorMetric(BinaryLoglossMetric):
+    def eval(self, score, objective):
+        prob = objective.convert_output(score) if objective is not None else score
+        pred_pos = prob > 0.5
+        err = (pred_pos.astype(jnp.float32) != self._is_pos).astype(jnp.float32)
+        if self._w is not None:
+            err = err * self._w
+        return [float(jnp.sum(err)) / self._sumw]
+
+
+def _auc(score, is_pos, weights):
+    """Weighted AUC via ranks: for each positive, count the fraction of
+    negatives scored below it (ties get half credit)."""
+    order = jnp.argsort(score)
+    s = score[order]
+    y = is_pos[order]
+    w = weights[order] if weights is not None else jnp.ones_like(s)
+    wneg = w * (1.0 - y)
+    wpos = w * y
+    cum_neg = jnp.cumsum(wneg)  # negatives with score <= s_i (inclusive)
+    # tie handling: within equal-score runs use (neg_below + neg_tied/2)
+    # compute run boundaries
+    neg_below_excl = cum_neg - wneg
+    # for ties: segment by equal score values
+    is_new = jnp.concatenate([jnp.array([True]), s[1:] > s[:-1]])
+    seg_id = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    n_seg = s.shape[0]
+    seg_neg = jax.ops.segment_sum(wneg, seg_id, num_segments=n_seg)
+    seg_cum = jnp.cumsum(seg_neg)
+    neg_in_seg = seg_neg[seg_id]
+    neg_before_seg = seg_cum[seg_id] - neg_in_seg
+    credit = neg_before_seg + 0.5 * neg_in_seg
+    total_pos = jnp.sum(wpos)
+    total_neg = jnp.sum(wneg)
+    auc = jnp.sum(wpos * credit) / jnp.maximum(total_pos * total_neg, 1e-30)
+    return auc
+
+
+@register_metric("auc")
+class AUCMetric(Metric):
+    greater_is_better = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        self._is_pos = jnp.asarray((metadata.label > 0).astype(np.float32))
+        self._w = (jnp.asarray(metadata.weights) if metadata.weights is not None else None)
+
+    def eval(self, score, objective):
+        return [float(_auc(score, self._is_pos, self._w))]
+
+
+@register_metric("average_precision")
+class AveragePrecisionMetric(AUCMetric):
+    def eval(self, score, objective):
+        order = jnp.argsort(-score)
+        y = self._is_pos[order]
+        w = self._w[order] if self._w is not None else jnp.ones_like(y)
+        wpos = w * y
+        cum_pos = jnp.cumsum(wpos)
+        cum_all = jnp.cumsum(w)
+        precision = cum_pos / jnp.maximum(cum_all, 1e-30)
+        total_pos = jnp.maximum(jnp.sum(wpos), 1e-30)
+        return [float(jnp.sum(precision * wpos) / total_pos)]

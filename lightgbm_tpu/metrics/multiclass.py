@@ -1,0 +1,91 @@
+"""Multiclass metrics — counterpart of src/metric/multiclass_metric.hpp:
+multi_logloss, multi_error (with multi_error_top_k), auc_mu."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import Metric, register_metric
+
+
+class _MulticlassBase(Metric):
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        self._label = jnp.asarray(metadata.label.astype(np.int32))
+        self._w = (jnp.asarray(metadata.weights) if metadata.weights is not None else None)
+        self._sumw = (float(np.sum(metadata.weights)) if metadata.weights is not None
+                      else float(num_data))
+
+    def _probs(self, score, objective):
+        # score [C, N] -> probabilities [N, C]
+        if objective is not None:
+            return objective.convert_output(score.T)
+        return jax.nn.softmax(score.T, axis=-1)
+
+
+@register_metric("multi_logloss", "multiclass", "softmax")
+class MultiLoglossMetric(_MulticlassBase):
+    def eval(self, score, objective):
+        p = self._probs(score, objective)
+        eps = 1e-15
+        rows = jnp.arange(p.shape[0])
+        loss = -jnp.log(jnp.clip(p[rows, self._label], eps, 1.0))
+        if self._w is not None:
+            loss = loss * self._w
+        return [float(jnp.sum(loss)) / self._sumw]
+
+
+@register_metric("multi_error")
+class MultiErrorMetric(_MulticlassBase):
+    def eval(self, score, objective):
+        p = self._probs(score, objective)
+        top_k = max(self.config.multi_error_top_k, 1)
+        rows = jnp.arange(p.shape[0])
+        true_p = p[rows, self._label]
+        # correct if the true class prob is within the top k (ties count,
+        # matching MultiErrorMetric::PointWiseLossCalculator)
+        rank = jnp.sum(p > true_p[:, None], axis=1)
+        correct = (rank < top_k).astype(jnp.float32)
+        err = 1.0 - correct
+        if self._w is not None:
+            err = err * self._w
+        return [float(jnp.sum(err)) / self._sumw]
+
+
+@register_metric("auc_mu")
+class AucMuMetric(_MulticlassBase):
+    greater_is_better = True
+
+    def eval(self, score, objective):
+        """AUC-mu (Kleiman & Page 2019) — mean pairwise class separability
+        (multiclass_metric.hpp auc_mu; uniform partition weights unless
+        auc_mu_weights given)."""
+        p = np.asarray(self._probs(score, objective))
+        label = np.asarray(self._label)
+        w = np.asarray(self._w) if self._w is not None else np.ones(len(label))
+        C = p.shape[1]
+        W = np.ones((C, C))
+        if self.config.auc_mu_weights:
+            W = np.asarray(self.config.auc_mu_weights, dtype=np.float64).reshape(C, C)
+        total = 0.0
+        count = 0
+        for a in range(C):
+            for b in range(a + 1, C):
+                ia = label == a
+                ib = label == b
+                if not ia.any() or not ib.any():
+                    continue
+                va = p[ia, a] - p[ia, b]
+                vb = p[ib, a] - p[ib, b]
+                wa, wb = w[ia], w[ib]
+                order = np.argsort(np.concatenate([va, vb]), kind="stable")
+                y = np.concatenate([np.ones(len(va)), np.zeros(len(vb))])[order]
+                ww = np.concatenate([wa, wb])[order]
+                cum_neg = np.cumsum(ww * (1 - y))
+                auc_num = float(np.sum(ww * y * cum_neg))
+                denom = float(np.sum(wa) * np.sum(wb))
+                if denom > 0:
+                    total += W[a, b] * auc_num / denom
+                    count += 1
+        return [total / max(count, 1)]

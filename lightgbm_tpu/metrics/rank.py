@@ -1,0 +1,136 @@
+"""Ranking metrics: NDCG@k and MAP@k.
+
+Counterpart of src/metric/rank_metric.hpp (NDCGMetric with eval_at positions,
+DCGCalculator + label-gain table, per-query parallel evaluation, query-weight
+support; queries with no relevant docs count as 1.0) and src/metric/
+map_metric.hpp (MapMetric).
+
+Device design: queries use the same padded [Q, L] bucket layout as the
+ranking objectives; a bucket's NDCG@k for all its queries is one jitted
+sort + gather + masked dot.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import Metric, register_metric
+from ..objectives.rank import QueryLayout, default_label_gain, max_dcg_at_k
+
+
+class _RankMetricBase(Metric):
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            from ..utils.log import Log
+
+            Log.fatal("The NDCG metric requires query information")
+        self.layout = QueryLayout(metadata.query_boundaries, metadata.label, num_data)
+        self.query_weights = metadata.query_weights
+        self.eval_at = [int(k) for k in (self.config.eval_at or [1, 2, 3, 4, 5])]
+
+
+@register_metric("ndcg")
+class NDCGMetric(_RankMetricBase):
+    greater_is_better = True
+
+    @property
+    def name(self):
+        return [f"ndcg@{k}" for k in self.eval_at]
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        gains = (np.array(self.config.label_gain, dtype=np.float64)
+                 if self.config.label_gain else default_label_gain())
+        self.gains = gains
+        self._gain_dev = jnp.asarray(gains, dtype=jnp.float32)
+        qb = metadata.query_boundaries
+        label = metadata.label
+        # per (query, k): 1/maxDCG@k ; 0 marks "no relevant docs" -> ndcg 1
+        inv = np.zeros((self.layout.num_queries, len(self.eval_at)))
+        for q in range(self.layout.num_queries):
+            srt = np.sort(label[qb[q]: qb[q + 1]])[::-1]
+            for j, k in enumerate(self.eval_at):
+                mx = max_dcg_at_k(srt, k, gains)
+                inv[q, j] = 1.0 / mx if mx > 0 else 0.0
+        for b in self.layout.buckets:
+            b["ndcg_inv"] = jnp.asarray(inv[b["qids"]], dtype=jnp.float32)
+        self._fns = {}
+
+    def _bucket_fn(self, L: int, ks: tuple):
+        key = (L, ks)
+        if key in self._fns:
+            return self._fns[key]
+        gains = self._gain_dev
+
+        def bucket(score_ext, doc_idx, lab, valid, inv):
+            s = jnp.where(valid, score_ext[doc_idx], -jnp.inf)
+            order = jnp.argsort(-s, axis=1, stable=True)
+            ls = jnp.take_along_axis(lab, order, axis=1)
+            vs = jnp.take_along_axis(valid, order, axis=1)
+            g = jnp.where(vs, gains[ls.astype(jnp.int32)], 0.0)
+            disc = 1.0 / jnp.log2(jnp.arange(L) + 2.0)
+            out = []
+            for j, k in enumerate(ks):
+                mask = jnp.arange(L) < k
+                dcg = jnp.sum(g * disc * mask, axis=1)
+                ndcg = jnp.where(inv[:, j] > 0, dcg * inv[:, j], 1.0)
+                out.append(ndcg)
+            return jnp.stack(out, axis=1)  # [Qb, n_ks]
+
+        fn = jax.jit(bucket)
+        self._fns[key] = fn
+        return fn
+
+    def eval(self, score, objective):
+        ks = tuple(self.eval_at)
+        totals = np.zeros(len(ks))
+        sumw = 0.0
+        for b in self.layout.buckets:
+            fn = self._bucket_fn(b["L"], ks)
+            score_ext = jnp.concatenate([score, jnp.zeros(1, score.dtype)])
+            ndcgs = np.asarray(fn(score_ext, b["doc_idx"], b["labels"],
+                                  b["valid"], b["ndcg_inv"]))
+            if self.query_weights is not None:
+                w = self.query_weights[b["qids"]]
+                totals += (ndcgs * w[:, None]).sum(axis=0)
+                sumw += w.sum()
+            else:
+                totals += ndcgs.sum(axis=0)
+                sumw += len(b["qids"])
+        return [float(t / max(sumw, 1e-20)) for t in totals]
+
+
+@register_metric("map", "mean_average_precision")
+class MapMetric(_RankMetricBase):
+    greater_is_better = True
+
+    @property
+    def name(self):
+        return [f"map@{k}" for k in self.eval_at]
+
+    def eval(self, score, objective):
+        """MAP@k per map_metric.hpp: labels > 0 are relevant."""
+        ks = self.eval_at
+        totals = np.zeros(len(ks))
+        sumw = 0.0
+        score_np = np.asarray(score)
+        for b in self.layout.buckets:
+            doc = np.asarray(b["doc_idx"])
+            lab = np.asarray(b["labels"])
+            valid = np.asarray(b["valid"])
+            s = np.where(valid, score_np[np.minimum(doc, len(score_np) - 1)], -np.inf)
+            order = np.argsort(-s, axis=1, kind="stable")
+            rel = np.take_along_axis((lab > 0) & valid, order, axis=1)
+            cum_rel = np.cumsum(rel, axis=1)
+            prec = cum_rel / (np.arange(rel.shape[1]) + 1.0)
+            w = (self.query_weights[b["qids"]] if self.query_weights is not None
+                 else np.ones(len(b["qids"])))
+            for j, k in enumerate(ks):
+                ap_num = (prec[:, :k] * rel[:, :k]).sum(axis=1)
+                denom = np.minimum(cum_rel[:, -1], k)
+                ap = np.where(denom > 0, ap_num / np.maximum(denom, 1), 1.0)
+                totals[j] += (ap * w).sum()
+            sumw += w.sum()
+        return [float(t / max(sumw, 1e-20)) for t in totals]

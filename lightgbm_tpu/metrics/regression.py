@@ -1,0 +1,150 @@
+"""Regression metrics — counterpart of src/metric/regression_metric.hpp."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import Metric, register_metric
+
+
+class _PointwiseRegression(Metric):
+    """Weighted mean of a pointwise loss over converted outputs."""
+
+    convert = True  # apply objective.convert_output first
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        self._label = jnp.asarray(metadata.label, dtype=jnp.float32)
+        self._w = (jnp.asarray(metadata.weights) if metadata.weights is not None else None)
+        self._sumw = (float(np.sum(metadata.weights)) if metadata.weights is not None
+                      else float(num_data))
+
+    def point_loss(self, pred, label):
+        raise NotImplementedError
+
+    def transform(self, avg):
+        return avg
+
+    def eval(self, score, objective):
+        pred = score
+        if self.convert and objective is not None:
+            pred = objective.convert_output(score)
+        losses = self.point_loss(pred, self._label)
+        if self._w is not None:
+            losses = losses * self._w
+        return [self.transform(float(jnp.sum(losses)) / self._sumw)]
+
+
+@register_metric("l2", "mean_squared_error", "mse", "regression", "regression_l2")
+class L2Metric(_PointwiseRegression):
+    def point_loss(self, pred, label):
+        return (pred - label) ** 2
+
+
+@register_metric("rmse", "root_mean_squared_error", "l2_root")
+class RMSEMetric(L2Metric):
+    def transform(self, avg):
+        return float(np.sqrt(avg))
+
+
+@register_metric("l1", "mean_absolute_error", "mae", "regression_l1")
+class L1Metric(_PointwiseRegression):
+    def point_loss(self, pred, label):
+        return jnp.abs(pred - label)
+
+
+@register_metric("quantile")
+class QuantileMetric(_PointwiseRegression):
+    def point_loss(self, pred, label):
+        alpha = self.config.alpha
+        delta = label - pred
+        return jnp.where(delta < 0, (alpha - 1.0) * delta, alpha * delta)
+
+
+@register_metric("huber")
+class HuberMetric(_PointwiseRegression):
+    def point_loss(self, pred, label):
+        alpha = self.config.alpha
+        diff = jnp.abs(pred - label)
+        return jnp.where(diff <= alpha, 0.5 * diff * diff,
+                         alpha * (diff - 0.5 * alpha))
+
+
+@register_metric("fair")
+class FairMetric(_PointwiseRegression):
+    def point_loss(self, pred, label):
+        c = self.config.fair_c
+        x = jnp.abs(pred - label)
+        return c * x - c * c * jnp.log1p(x / c)
+
+
+@register_metric("poisson")
+class PoissonMetric(_PointwiseRegression):
+    def point_loss(self, pred, label):
+        eps = 1e-10
+        pred = jnp.maximum(pred, eps)
+        return pred - label * jnp.log(pred)
+
+
+@register_metric("mape", "mean_absolute_percentage_error")
+class MAPEMetric(_PointwiseRegression):
+    def point_loss(self, pred, label):
+        return jnp.abs((label - pred) / jnp.maximum(1.0, jnp.abs(label)))
+
+
+@register_metric("gamma")
+class GammaMetric(_PointwiseRegression):
+    def point_loss(self, pred, label):
+        psi = 1.0
+        theta = -1.0 / jnp.maximum(pred, 1e-10)
+        a = psi
+        b = -jnp.log(-theta)
+        c = 1.0 / psi * jnp.log(label / psi) - jnp.log(label) - 0.0
+        return -((label * theta - b) / a + c)
+
+
+@register_metric("gamma_deviance")
+class GammaDevianceMetric(_PointwiseRegression):
+    def point_loss(self, pred, label):
+        epsilon = 1e-9
+        tmp = label / (pred + epsilon)
+        return tmp - jnp.log(tmp) - 1.0
+
+    def transform(self, avg):
+        return avg * 2.0 * self._sumw / self._sumw  # deviance uses sum*2
+
+    def eval(self, score, objective):
+        pred = score
+        if objective is not None:
+            pred = objective.convert_output(score)
+        losses = self.point_loss(pred, self._label)
+        if self._w is not None:
+            losses = losses * self._w
+        return [float(jnp.sum(losses)) * 2.0]
+
+
+@register_metric("tweedie")
+class TweedieMetric(_PointwiseRegression):
+    def point_loss(self, pred, label):
+        rho = self.config.tweedie_variance_power
+        eps = 1e-10
+        pred = jnp.maximum(pred, eps)
+        a = label * jnp.exp((1.0 - rho) * jnp.log(pred)) / (1.0 - rho)
+        b = jnp.exp((2.0 - rho) * jnp.log(pred)) / (2.0 - rho)
+        return -a + b
+
+
+@register_metric("r2")
+class R2Metric(_PointwiseRegression):
+    greater_is_better = True
+
+    def eval(self, score, objective):
+        pred = score
+        if objective is not None:
+            pred = objective.convert_output(score)
+        label = self._label
+        w = self._w if self._w is not None else jnp.ones_like(label)
+        mean = jnp.sum(label * w) / jnp.sum(w)
+        ss_res = jnp.sum(w * (label - pred) ** 2)
+        ss_tot = jnp.sum(w * (label - mean) ** 2)
+        return [float(1.0 - ss_res / jnp.maximum(ss_tot, 1e-30))]

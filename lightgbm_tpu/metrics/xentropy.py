@@ -1,0 +1,60 @@
+"""Cross-entropy metrics — counterpart of src/metric/xentropy_metric.hpp:
+cross_entropy, cross_entropy_lambda, kullback_leibler."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import Metric, register_metric
+
+K_EPS = 1e-12
+
+
+def _xent(label, prob):
+    p = jnp.clip(prob, K_EPS, 1.0 - K_EPS)
+    return -(label * jnp.log(p) + (1.0 - label) * jnp.log(1.0 - p))
+
+
+class _XentMetricBase(Metric):
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        self._label = jnp.asarray(metadata.label, dtype=jnp.float32)
+        self._w = (jnp.asarray(metadata.weights) if metadata.weights is not None else None)
+        self._sumw = (float(np.sum(metadata.weights)) if metadata.weights is not None
+                      else float(num_data))
+
+
+@register_metric("cross_entropy", "xentropy")
+class CrossEntropyMetric(_XentMetricBase):
+    def eval(self, score, objective):
+        prob = objective.convert_output(score) if objective is not None else \
+            1.0 / (1.0 + jnp.exp(-score))
+        loss = _xent(self._label, prob)
+        if self._w is not None:
+            loss = loss * self._w
+        return [float(jnp.sum(loss)) / self._sumw]
+
+
+@register_metric("cross_entropy_lambda", "xentlambda")
+class CrossEntropyLambdaMetric(_XentMetricBase):
+    def eval(self, score, objective):
+        # z = 1 - exp(-w * log1p(exp(score))) — xentropy_metric.hpp xentlambda
+        hhat = jnp.log1p(jnp.exp(score))
+        w = self._w if self._w is not None else 1.0
+        z = jnp.clip(1.0 - jnp.exp(-w * hhat), K_EPS, 1.0 - K_EPS)
+        loss = -(self._label * jnp.log(z) + (1.0 - self._label) * jnp.log(1.0 - z))
+        return [float(jnp.sum(loss)) / self._sumw]
+
+
+@register_metric("kullback_leibler", "kldiv")
+class KullbackLeiblerMetric(_XentMetricBase):
+    def eval(self, score, objective):
+        prob = objective.convert_output(score) if objective is not None else \
+            1.0 / (1.0 + jnp.exp(-score))
+        y = jnp.clip(self._label, K_EPS, 1.0 - K_EPS)
+        # KL(y || p) = xent(y, p) - entropy(y)
+        ent = -(y * jnp.log(y) + (1.0 - y) * jnp.log(1.0 - y))
+        loss = _xent(self._label, prob) - ent
+        if self._w is not None:
+            loss = loss * self._w
+        return [float(jnp.sum(loss)) / self._sumw]

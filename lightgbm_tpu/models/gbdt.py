@@ -1,0 +1,312 @@
+"""GBDT boosting driver.
+
+Counterpart of GBDT (src/boosting/gbdt.cpp): gradient boosting loop with
+boost-from-average, per-class tree training, leaf-value renewal, shrinkage,
+train/valid score maintenance, eval, and model export. The TrainOneIter
+control flow mirrors gbdt.cpp:352-460 (init-score handling, constant trees,
+should_continue semantics); score updates are device scatter-adds over the
+partition's per-leaf index sets (the CUDAScoreUpdater analog).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..io.dataset import Dataset
+from ..metrics import create_metric
+from ..objectives import ObjectiveFunction
+from ..ops.predict import pack_ensemble, predict_raw
+from ..treelearner import create_tree_learner
+from ..utils.log import Log
+from ..utils.timer import global_timer
+from .serialize import GBDTModel
+from .tree import Tree
+
+K_EPSILON = 1e-15
+
+
+def _pack_gh(grad: jax.Array, hess: jax.Array) -> jax.Array:
+    """[N] grad/hess -> [N+1, 3] with count channel and zero sentinel row."""
+    gh = jnp.stack([grad, hess, jnp.ones_like(grad)], axis=1)
+    return jnp.concatenate([gh, jnp.zeros((1, 3), gh.dtype)], axis=0)
+
+
+class _ValidData:
+    """Holds one validation set's device raw matrix, metadata, score."""
+
+    def __init__(self, dataset: Dataset, raw: np.ndarray, metrics) -> None:
+        self.dataset = dataset
+        self.raw = jnp.asarray(raw, dtype=jnp.float32)
+        self.metrics = metrics
+        self.score: Optional[jax.Array] = None
+
+
+class GBDT:
+    """The training driver. One instance per Booster."""
+
+    def __init__(self, config: Config, train_set: Optional[Dataset],
+                 objective: Optional[ObjectiveFunction],
+                 train_raw: Optional[np.ndarray] = None) -> None:
+        self.config = config
+        self.train_set = train_set
+        self.objective = objective
+        self.iter_ = 0
+        self.models: List[Tree] = []
+        self.best_iteration = 0
+        self.shrinkage_rate = config.learning_rate
+        self.num_class = max(config.num_class, 1)
+        if objective is not None:
+            self.num_tree_per_iteration = objective.num_model_per_iteration
+        else:
+            self.num_tree_per_iteration = self.num_class if self.num_class > 1 else 1
+        self.class_need_train = [True] * self.num_tree_per_iteration
+        if objective is not None and hasattr(objective, "class_need_train"):
+            pass  # resolved after objective.init (below)
+        self._packed_cache = None
+        self.valid_sets: List[_ValidData] = []
+        self.valid_names: List[str] = []
+
+        if train_set is not None:
+            n = train_set.num_data
+            self.num_data = n
+            if objective is not None:
+                objective.init(train_set.metadata, n)
+                if hasattr(objective, "class_need_train"):
+                    self.class_need_train = [
+                        objective.class_need_train(c)
+                        for c in range(self.num_tree_per_iteration)]
+            self.tree_learner = create_tree_learner(
+                config.tree_learner, config.device_type, config, train_set)
+            self.train_metrics = [m for m in
+                                  (create_metric(name, config) for name in config.metric)
+                                  if m is not None]
+            for m in self.train_metrics:
+                m.init(train_set.metadata, n)
+            # scores [C, N]
+            self.score = jnp.zeros((self.num_tree_per_iteration, n), dtype=jnp.float32)
+            init = train_set.metadata.init_score
+            self._has_init_score = init is not None
+            if self._has_init_score:
+                self.score = jnp.asarray(
+                    np.asarray(init, dtype=np.float32).reshape(
+                        self.num_tree_per_iteration, n))
+            if objective is None:
+                self._grad_fn = None
+            elif objective.jit_gradients:
+                self._grad_fn = jax.jit(self._compute_gh)
+            else:
+                self._grad_fn = self._compute_gh
+            self.train_raw = train_raw
+
+    # ------------------------------------------------------------------ valid
+
+    def add_valid(self, valid: Dataset, raw: np.ndarray, name: str) -> None:
+        metrics = [m for m in (create_metric(nm, self.config) for nm in self.config.metric)
+                   if m is not None]
+        for m in metrics:
+            m.init(valid.metadata, valid.num_data)
+        vd = _ValidData(valid, raw, metrics)
+        vd.score = jnp.zeros((self.num_tree_per_iteration, valid.num_data),
+                             dtype=jnp.float32)
+        if valid.metadata.init_score is not None:
+            vd.score = jnp.asarray(np.asarray(valid.metadata.init_score, dtype=np.float32)
+                                   .reshape(self.num_tree_per_iteration, valid.num_data))
+        self.valid_sets.append(vd)
+        self.valid_names.append(name)
+
+    # --------------------------------------------------------------- boosting
+
+    def _compute_gh(self, score):
+        """C==1: score [N] -> gh_ext [N+1, 3]. C>1: score [C, N] ->
+        (grad [C, N], hess [C, N]) — the whole-iteration gradient pass."""
+        if self.num_tree_per_iteration > 1:
+            return self.objective.get_gradients(score)
+        grad, hess = self.objective.get_gradients(score)
+        return _pack_gh(grad, hess)
+
+    def boost_from_average(self, class_id: int) -> float:
+        """gbdt.cpp:327-350."""
+        if (not self.models and not self._has_init_score
+                and self.objective is not None and self.config.boost_from_average):
+            init = self.objective.boost_from_score(class_id)
+            if abs(init) > K_EPSILON:
+                self.score = self.score.at[class_id].add(init)
+                for vd in self.valid_sets:
+                    vd.score = vd.score.at[class_id].add(init)
+                Log.info("Start training from score %f", init)
+                return init
+        return 0.0
+
+    def train_one_iter(self, gradients: Optional[np.ndarray] = None,
+                       hessians: Optional[np.ndarray] = None) -> bool:
+        """Returns True when training should STOP (no more valid splits) —
+        matching LGBM_BoosterUpdateOneIter's is_finished flag."""
+        C = self.num_tree_per_iteration
+        init_scores = [0.0] * C
+        custom = gradients is not None
+        if not custom:
+            if self.objective is None:
+                Log.fatal("No object function provided")
+            for c in range(C):
+                init_scores[c] = self.boost_from_average(c)
+        should_continue = False
+        all_grads = all_hesses = None
+        if not custom and C > 1:
+            with global_timer.scope("boosting"):
+                all_grads, all_hesses = self._grad_fn(self.score)
+        for c in range(C):
+            with global_timer.scope("boosting"):
+                if custom:
+                    g = jnp.asarray(gradients.reshape(C, self.num_data)[c])
+                    h = jnp.asarray(hessians.reshape(C, self.num_data)[c])
+                    gh_ext = _pack_gh(g, h)
+                elif C > 1:
+                    gh_ext = _pack_gh(all_grads[c], all_hesses[c])
+                else:
+                    gh_ext = self._grad_fn(self.score[0])
+            bag = self._bag_indices(c)
+            new_tree = Tree(2)
+            if self.class_need_train[c] and self.train_set.num_features > 0:
+                with global_timer.scope("tree_train"):
+                    new_tree = self.tree_learner.train(gh_ext, bag)
+            if new_tree.num_leaves > 1:
+                should_continue = True
+                if self.objective is not None:
+                    self.objective.renew_tree_output(
+                        new_tree, self.score[c], self.tree_learner.partition)
+                new_tree.shrink(self.shrinkage_rate)
+                with global_timer.scope("update_score"):
+                    self._update_train_score(new_tree, c)
+                    self._update_valid_scores(new_tree, c)
+                if abs(init_scores[c]) > K_EPSILON:
+                    new_tree.add_bias(init_scores[c])
+            else:
+                if len(self.models) < C:
+                    if (self.objective is not None and not self.config.boost_from_average
+                            and not self._has_init_score):
+                        init_scores[c] = self.objective.boost_from_score(c)
+                        self.score = self.score.at[c].add(init_scores[c])
+                        for vd in self.valid_sets:
+                            vd.score = vd.score.at[c].add(init_scores[c])
+                    new_tree.as_constant_tree(init_scores[c])
+                else:
+                    new_tree.as_constant_tree(0.0)
+            self.models.append(new_tree)
+        self._packed_cache = None
+        if not should_continue:
+            Log.warning("Stopped training because there are no more leaves that "
+                        "meet the split requirements")
+            if len(self.models) > C:
+                del self.models[-C:]
+            return True
+        self.iter_ += 1
+        return False
+
+    def _bag_indices(self, class_id: int) -> Optional[np.ndarray]:
+        return None  # bagging/GOSS strategies plug in here
+
+    # ------------------------------------------------------------------ score
+
+    def _update_train_score(self, tree: Tree, class_id: int) -> None:
+        part = self.tree_learner.partition
+        score = self.score[class_id]
+        for leaf in range(tree.num_leaves):
+            idx = part.indices(leaf)
+            score = score.at[idx].add(tree.leaf_value[leaf], mode="drop")
+        self.score = self.score.at[class_id].set(score)
+
+    def _update_valid_scores(self, tree: Tree, class_id: int) -> None:
+        if not self.valid_sets:
+            return
+        depth_bound = (self.config.max_depth if self.config.max_depth > 0
+                       else self.config.num_leaves - 1)
+        packed = pack_ensemble([tree], fixed_leaves=self.config.num_leaves,
+                               fixed_depth=depth_bound)
+        for vd in self.valid_sets:
+            delta = predict_raw(packed, vd.raw)[:, 0]
+            vd.score = vd.score.at[class_id].add(delta)
+
+    # ------------------------------------------------------------------- eval
+
+    def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        for m in self.train_metrics:
+            for name, val in zip(m.name, m.eval(self.score[0] if self.num_tree_per_iteration == 1
+                                                else self.score, self.objective)):
+                out.append(("training", name, val, m.greater_is_better))
+        return out
+
+    def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        for vname, vd in zip(self.valid_names, self.valid_sets):
+            for m in vd.metrics:
+                score = vd.score[0] if self.num_tree_per_iteration == 1 else vd.score
+                for name, val in zip(m.name, m.eval(score, self.objective)):
+                    out.append((vname, name, val, m.greater_is_better))
+        return out
+
+    # ---------------------------------------------------------------- predict
+
+    def _packed(self, num_iteration: int = 0):
+        n_trees = len(self.models)
+        if num_iteration > 0:
+            n_trees = min(n_trees, num_iteration * self.num_tree_per_iteration)
+        key = n_trees
+        if self._packed_cache is None or self._packed_cache[0] != key:
+            self._packed_cache = (key, pack_ensemble(self.models[:n_trees]))
+        return self._packed_cache[1]
+
+    def predict(self, X: np.ndarray, raw_score: bool = False,
+                num_iteration: int = 0) -> np.ndarray:
+        packed = self._packed(num_iteration)
+        out = predict_raw(packed, jnp.asarray(X, dtype=jnp.float32),
+                          self.num_tree_per_iteration)
+        if not raw_score and self.objective is not None:
+            out = self.objective.convert_output(out)
+        res = np.asarray(out)
+        return res[:, 0] if res.shape[1] == 1 else res
+
+    def predict_leaf_index(self, X: np.ndarray, num_iteration: int = 0) -> np.ndarray:
+        from ..ops.predict import predict_leaf_indices
+
+        packed = self._packed(num_iteration)
+        return np.asarray(predict_leaf_indices(packed, jnp.asarray(X, dtype=jnp.float32)))
+
+    # ------------------------------------------------------------------ model
+
+    def rollback_one_iter(self) -> None:
+        """RollbackOneIter (gbdt.cpp:462): drop the last iteration's trees and
+        back out their score contributions."""
+        if self.iter_ <= 0:
+            return
+        C = self.num_tree_per_iteration
+        for c in range(C):
+            tree = self.models[-C + c]
+            inv = Tree(max(tree.max_leaves, 2))
+            # subtract by re-adding the negated tree through the packed path
+            tree.shrink(-1.0)
+            self._update_train_score(tree, c)
+            self._update_valid_scores(tree, c)
+            tree.shrink(-1.0)
+        del self.models[-C:]
+        self.iter_ -= 1
+        self._packed_cache = None
+
+    def to_model(self) -> GBDTModel:
+        ds = self.train_set
+        model = GBDTModel()
+        model.num_class = self.num_class
+        model.num_tree_per_iteration = self.num_tree_per_iteration
+        model.max_feature_idx = (ds.num_total_features - 1) if ds is not None else 0
+        model.objective_str = self.objective.to_string() if self.objective else None
+        model.feature_names = ds.feature_names if ds is not None else []
+        model.feature_infos = ds.feature_infos() if ds is not None else []
+        model.monotone_constraints = list(ds.monotone_constraints) if ds is not None else []
+        model.trees = self.models
+        model.best_iteration = self.best_iteration
+        model.parameters_str = self.config.to_string()
+        return model

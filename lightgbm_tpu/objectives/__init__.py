@@ -1,0 +1,3 @@
+from .registry import create_objective, ObjectiveFunction, OBJECTIVE_REGISTRY
+
+__all__ = ["create_objective", "ObjectiveFunction", "OBJECTIVE_REGISTRY"]

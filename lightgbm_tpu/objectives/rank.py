@@ -1,0 +1,273 @@
+"""Learning-to-rank objectives: lambdarank and rank_xendcg.
+
+Counterpart of src/objective/rank_objective.hpp: RankingObjective (per-query
+gradient computation, :25-100), LambdarankNDCG (:138-290: |ΔNDCG|-weighted
+pairwise lambdas with truncation, sigmoid scaling, and lambda normalization)
+and RankXENDCG (:300+).
+
+TPU design: the reference parallelizes with one OpenMP task per query over
+ragged boundaries. Here queries are padded into dense [Q, L] blocks bucketed
+by length (powers of two), and the whole pairwise lambda computation for a
+bucket is one jitted tensor program: sort by score, build the [L, L] pairwise
+ΔNDCG/sigmoid matrices, reduce rows, and scatter back to the flat row space.
+Pad slots carry score = -inf so they sort last and are masked out of pairs.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import ObjectiveFunction, register_objective
+from ..utils.log import Log
+
+K_MIN_SCORE = -1e30
+
+
+def default_label_gain(max_label: int = 31) -> np.ndarray:
+    """DCGCalculator::DefaultLabelGain (dcg_calculator.cpp:33-42): 2^i - 1."""
+    g = [0.0]
+    for i in range(1, max_label):
+        g.append(float((1 << i) - 1))
+    return np.array(g)
+
+
+class QueryLayout:
+    """Padded per-bucket query layout shared by ranking objectives/metrics.
+
+    For each power-of-two length bucket: doc_idx [Qb, Lb] (global row ids,
+    pad = num_data), labels [Qb, Lb], valid mask, and the query ids.
+    """
+
+    def __init__(self, query_boundaries: np.ndarray, labels: np.ndarray,
+                 num_data: int, min_bucket: int = 8) -> None:
+        self.num_data = num_data
+        self.num_queries = len(query_boundaries) - 1
+        lengths = np.diff(query_boundaries)
+        buckets: Dict[int, List[int]] = {}
+        for q, ln in enumerate(lengths):
+            b = min_bucket
+            while b < ln:
+                b <<= 1
+            buckets.setdefault(b, []).append(q)
+        self.buckets = []
+        for L, qids in sorted(buckets.items()):
+            Qb = len(qids)
+            doc_idx = np.full((Qb, L), num_data, dtype=np.int32)
+            lab = np.zeros((Qb, L), dtype=np.float32)
+            for r, q in enumerate(qids):
+                lo, hi = query_boundaries[q], query_boundaries[q + 1]
+                doc_idx[r, : hi - lo] = np.arange(lo, hi)
+                lab[r, : hi - lo] = labels[lo:hi]
+            valid = doc_idx < num_data
+            self.buckets.append({
+                "L": L,
+                "qids": np.array(qids),
+                "doc_idx": jnp.asarray(doc_idx),
+                "labels": jnp.asarray(lab),
+                "valid": jnp.asarray(valid),
+            })
+
+
+def max_dcg_at_k(labels_sorted_desc: np.ndarray, k: int, gains: np.ndarray) -> float:
+    """DCGCalculator::CalMaxDCGAtK."""
+    n = min(len(labels_sorted_desc), k)
+    disc = 1.0 / np.log2(np.arange(n) + 2.0)
+    return float(np.sum(gains[labels_sorted_desc[:n].astype(int)] * disc))
+
+
+@register_objective("lambdarank")
+class LambdarankNDCG(ObjectiveFunction):
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = config.sigmoid
+        if self.sigmoid <= 0:
+            Log.fatal("Sigmoid param %f should be greater than zero", self.sigmoid)
+        self.norm = config.lambdarank_norm
+        self.truncation_level = config.lambdarank_truncation_level
+        gains = np.array(config.label_gain, dtype=np.float64) if config.label_gain \
+            else default_label_gain()
+        self.label_gain = gains
+
+    jit_gradients = False  # manages per-bucket jits internally
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            Log.fatal("Ranking tasks require query information")
+        qb = metadata.query_boundaries
+        label = metadata.label
+        if label.max() >= len(self.label_gain):
+            Log.fatal("Label %d is not less than the number of label mappings (%d)",
+                      int(label.max()), len(self.label_gain))
+        self.layout = QueryLayout(qb, label, num_data)
+        # per-query 1/maxDCG@trunc
+        inv = np.zeros(self.layout.num_queries)
+        for q in range(self.layout.num_queries):
+            lo, hi = qb[q], qb[q + 1]
+            srt = np.sort(label[lo:hi])[::-1]
+            mx = max_dcg_at_k(srt, self.truncation_level, self.label_gain)
+            inv[q] = 1.0 / mx if mx > 0 else 0.0
+        for b in self.layout.buckets:
+            b["inv_max_dcg"] = jnp.asarray(inv[b["qids"]], dtype=jnp.float32)
+        self._w = (jnp.asarray(metadata.weights) if metadata.weights is not None else None)
+        self._gain_dev = jnp.asarray(self.label_gain, dtype=jnp.float32)
+        self._fns = {}
+
+    def _bucket_fn(self, L: int):
+        if L in self._fns:
+            return self._fns[L]
+        sigmoid = self.sigmoid
+        trunc = self.truncation_level
+        norm = self.norm
+        gains = self._gain_dev
+
+        def per_query(s, lab, valid, inv_max_dcg):
+            # s, lab, valid: [L]
+            s_pad = jnp.where(valid, s, K_MIN_SCORE)
+            order = jnp.argsort(-s_pad, stable=True)
+            ss = s_pad[order]
+            ls = lab[order]
+            vs = valid[order]
+            g = gains[ls.astype(jnp.int32)]
+            pos = jnp.arange(L)
+            disc = jnp.where(vs, 1.0 / jnp.log2(pos + 2.0), 0.0)
+            best = ss[0]
+            cnt = vs.sum()
+            worst = jnp.where(cnt > 0, ss[jnp.maximum(cnt - 1, 0)], 0.0)
+            # pairwise matrices over sorted positions
+            ds = ss[:, None] - ss[None, :]
+            sign = jnp.sign(ls[:, None] - ls[None, :])
+            delta_hl = sign * ds  # score(high-label) - score(low-label)
+            dcg_gap = jnp.abs(g[:, None] - g[None, :])
+            paired_disc = jnp.abs(disc[:, None] - disc[None, :])
+            delta_ndcg = dcg_gap * paired_disc * inv_max_dcg
+            if norm:
+                delta_ndcg = jnp.where(best != worst,
+                                       delta_ndcg / (0.01 + jnp.abs(ds)), delta_ndcg)
+            p = 1.0 / (1.0 + jnp.exp(delta_hl * sigmoid))
+            pair_ok = (vs[:, None] & vs[None, :] & (sign != 0)
+                       & ((jnp.minimum(pos[:, None], pos[None, :])) < trunc)
+                       & (pos[:, None] != pos[None, :]))
+            p_lambda = jnp.where(pair_ok, -sigmoid * delta_ndcg * p, 0.0)
+            p_hess = jnp.where(pair_ok, sigmoid * sigmoid * delta_ndcg * p * (1.0 - p), 0.0)
+            lam_sorted = jnp.sum(sign * p_lambda, axis=1)
+            hes_sorted = jnp.sum(p_hess, axis=1)
+            sum_lambdas = -jnp.sum(p_lambda)
+            if norm:
+                factor = jnp.where(sum_lambdas > 0,
+                                   jnp.log2(1.0 + sum_lambdas) / jnp.maximum(sum_lambdas, 1e-20),
+                                   1.0)
+                lam_sorted = lam_sorted * factor
+                hes_sorted = hes_sorted * factor
+            # unsort back to query-local order
+            lam = jnp.zeros(L).at[order].set(lam_sorted)
+            hes = jnp.zeros(L).at[order].set(hes_sorted)
+            return lam, hes
+
+        def bucket(score_ext, doc_idx, lab, valid, inv_max_dcg):
+            s = score_ext[doc_idx]  # [Qb, L]
+            if L >= 512:
+                lam, hes = jax.lax.map(
+                    lambda args: per_query(*args), (s, lab, valid, inv_max_dcg))
+            else:
+                lam, hes = jax.vmap(per_query)(s, lab, valid, inv_max_dcg)
+            return lam, hes
+
+        fn = jax.jit(bucket)
+        self._fns[L] = fn
+        return fn
+
+    def get_gradients(self, score):
+        n = self.num_data
+        score_ext = jnp.concatenate([score, jnp.zeros(1, score.dtype)])
+        grad = jnp.zeros(n, dtype=jnp.float32)
+        hess = jnp.zeros(n, dtype=jnp.float32)
+        for b in self.layout.buckets:
+            fn = self._bucket_fn(b["L"])
+            lam, hes = fn(score_ext, b["doc_idx"], b["labels"], b["valid"],
+                          b["inv_max_dcg"])
+            grad = grad.at[b["doc_idx"].ravel()].set(lam.ravel(), mode="drop")
+            hess = hess.at[b["doc_idx"].ravel()].set(hes.ravel(), mode="drop")
+        if self._w is not None:
+            grad = grad * self._w
+            hess = hess * self._w
+        return grad, hess
+
+    def to_string(self):
+        return "lambdarank"
+
+
+@register_objective("rank_xendcg")
+class RankXENDCG(ObjectiveFunction):
+    """XE-NDCG (Bruch et al. 2019, 'An Alternative Cross Entropy Loss for
+    Learning-to-Rank'): listwise softmax cross-entropy with randomly
+    perturbed relevance gains (rank_objective.hpp RankXENDCG)."""
+
+    jit_gradients = False  # stateful per-iteration RNG + per-bucket jits
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.seed = config.objective_seed
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            Log.fatal("Ranking tasks require query information")
+        self.layout = QueryLayout(metadata.query_boundaries, metadata.label, num_data)
+        self._w = (jnp.asarray(metadata.weights) if metadata.weights is not None else None)
+        self._iter = 0
+        self._fns = {}
+
+    def _bucket_fn(self, L: int):
+        if L in self._fns:
+            return self._fns[L]
+
+        def per_query(s, lab, valid, seed):
+            s_masked = jnp.where(valid, s, -jnp.inf)
+            key = jax.random.PRNGKey(seed.astype(jnp.uint32))
+            # phi: gumbel-perturbed gains, normalized (the paper's sampling)
+            gumbel = jax.random.uniform(key, (L,), minval=1e-6, maxval=1.0)
+            gain = jnp.where(valid, (2.0 ** lab - 1.0) - jnp.log(-jnp.log(gumbel)), 0.0)
+            gain = jnp.maximum(gain, 0.0)
+            rho = jax.nn.softmax(s_masked)
+            rho = jnp.where(valid, rho, 0.0)
+            gsum = jnp.maximum(gain.sum(), 1e-20)
+            phi = gain / gsum
+            lam = rho - phi
+            hes = jnp.maximum(rho * (1.0 - rho), 1e-16)
+            return jnp.where(valid, lam, 0.0), jnp.where(valid, hes, 0.0)
+
+        def bucket(score_ext, doc_idx, lab, valid, seeds):
+            s = score_ext[doc_idx]
+            return jax.vmap(per_query)(s, lab, valid, seeds)
+
+        fn = jax.jit(bucket)
+        self._fns[L] = fn
+        return fn
+
+    def get_gradients(self, score):
+        n = self.num_data
+        score_ext = jnp.concatenate([score, jnp.zeros(1, score.dtype)])
+        grad = jnp.zeros(n, dtype=jnp.float32)
+        hess = jnp.zeros(n, dtype=jnp.float32)
+        self._iter += 1
+        for b in self.layout.buckets:
+            fn = self._bucket_fn(b["L"])
+            seeds = jnp.asarray(
+                (b["qids"].astype(np.int64) * 9973 + self._iter * 31 + self.seed)
+                % (2 ** 31), dtype=jnp.int32)
+            lam, hes = fn(score_ext, b["doc_idx"], b["labels"], b["valid"], seeds)
+            grad = grad.at[b["doc_idx"].ravel()].set(lam.ravel(), mode="drop")
+            hess = hess.at[b["doc_idx"].ravel()].set(hes.ravel(), mode="drop")
+        if self._w is not None:
+            grad = grad * self._w
+            hess = hess * self._w
+        return grad, hess
+
+    def to_string(self):
+        return "rank_xendcg"

@@ -1,0 +1,160 @@
+"""Leaf row partition: per-leaf padded index sets on device.
+
+TPU-native replacement for DataPartition (src/treelearner/data_partition.hpp)
+and CUDADataPartition's bitvector + prefix-scan compaction
+(src/treelearner/cuda/cuda_data_partition.cu, GenDataToLeftBitVector/
+SplitInner).
+
+Design: instead of one globally permuted index array with host-tracked leaf
+ranges (awkward under XLA's static shapes), each leaf owns a padded device
+index array. Padding uses the sentinel index N, which
+
+  * gathers the zero row of the extended gradient array (histograms), and
+  * is dropped by scatter-adds with mode="drop" (score updates).
+
+A split evaluates the bin-level decision (NumericalDecisionInner semantics,
+include/LightGBM/tree.h:357-371) over the parent's indices, then performs a
+stable partition via argsort on a 3-way key (left < right < padding) — the
+XLA-friendly equivalent of the CUDA prefix-scan compaction. Children reuse
+power-of-two padded buffers so jit caches stay bounded (one compiled kernel
+per bucket size).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+
+def bucket_size(n: int, minimum: int = 256) -> int:
+    """Power-of-two padded size for a leaf of n rows."""
+    p = minimum
+    while p < n:
+        p <<= 1
+    return p
+
+
+def pad_indices(idx: np.ndarray, n_data: int, minimum: int = 256) -> np.ndarray:
+    """Pad a host index array with the sentinel N to its bucket size."""
+    p = bucket_size(len(idx), minimum)
+    out = np.full(p, n_data, dtype=np.int32)
+    out[: len(idx)] = idx
+    return out
+
+
+@jax.jit
+def split_decision_bins(group_bins: jax.Array, decision: jax.Array) -> jax.Array:
+    """go_left for raw GROUP bins of the split group.
+
+    decision: device vector
+      [0]=threshold (feature-bin space), [1]=default_left, [2]=missing_type,
+      [3]=feature default_bin, [4]=feature nbins, [5]=efb_lo, [6]=efb_hi,
+      [7]=is_efb (group bins need translation to feature bins)
+    Implements NumericalDecisionInner: missing bin -> default side, otherwise
+    bin <= threshold.
+    """
+    thresh = decision[0].astype(jnp.int32)
+    default_left = decision[1] > 0.5
+    missing_type = decision[2].astype(jnp.int32)
+    default_bin = decision[3].astype(jnp.int32)
+    nbins = decision[4].astype(jnp.int32)
+    lo = decision[5].astype(jnp.int32)
+    hi = decision[6].astype(jnp.int32)
+    is_efb = decision[7] > 0.5
+
+    gb = group_bins.astype(jnp.int32)
+    # EFB translation: group bin in [lo, hi) -> natural feature bin
+    # (undo the default-bin removal shift); anything else -> default bin
+    in_range = (gb >= lo) & (gb < hi)
+    shifted = gb - lo
+    natural = shifted + (shifted >= default_bin).astype(jnp.int32)
+    fbin = jnp.where(is_efb, jnp.where(in_range, natural, default_bin), gb)
+
+    is_missing = jnp.where(
+        missing_type == MISSING_NAN, fbin == nbins - 1,
+        jnp.where(missing_type == MISSING_ZERO, fbin == default_bin, False))
+    return jnp.where(is_missing, default_left, fbin <= thresh)
+
+
+@jax.jit
+def partition_rows(bins_row: jax.Array, row_idx: jax.Array, count: jax.Array,
+                   decision: jax.Array, n_data: int
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Stable-partition a leaf's padded indices by the split decision.
+
+    bins_row: [N] group-bin column of the split group
+    row_idx:  [P] padded leaf indices (sentinel = n_data)
+    count:    scalar actual row count
+    Returns (sorted_idx [P] — left rows first, then right, then sentinel
+    padding — and left_count).
+    """
+    P = row_idx.shape[0]
+    valid = jnp.arange(P) < count
+    gb = jnp.take(bins_row, jnp.minimum(row_idx, n_data - 1))
+    go_left = split_decision_bins(gb, decision) & valid
+    key = jnp.where(go_left, 0, jnp.where(valid, 1, 2)).astype(jnp.int32)
+    order = jnp.argsort(key, stable=True)
+    sorted_idx = jnp.where(jnp.arange(P) < count, row_idx[order], n_data)
+    return sorted_idx, go_left.sum()
+
+
+class RowPartition:
+    """Host orchestrator of per-leaf device index arrays.
+
+    leaf -> (device idx array padded to a power-of-two bucket, host count).
+    The root leaf starts with all rows. One device->host sync per split (the
+    left count), mirroring the CUDA learner's per-split scalar sync
+    (cuda_single_gpu_tree_learner.cpp:291-330).
+    """
+
+    def __init__(self, num_data: int, min_bucket: int = 256) -> None:
+        self.num_data = num_data
+        self.min_bucket = min_bucket
+        root = np.arange(num_data, dtype=np.int32)
+        self.leaf_idx = {0: jnp.asarray(pad_indices(root, num_data, min_bucket))}
+        self.leaf_count = {0: num_data}
+
+    def indices(self, leaf: int) -> jax.Array:
+        return self.leaf_idx[leaf]
+
+    def count(self, leaf: int) -> int:
+        return self.leaf_count[leaf]
+
+    def split(self, leaf: int, new_leaf: int, bins_row: jax.Array,
+              decision: jax.Array) -> Tuple[int, int]:
+        """Split `leaf` in place; left stays as `leaf`, right becomes
+        `new_leaf`. Returns (left_count, right_count)."""
+        idx = self.leaf_idx[leaf]
+        cnt = self.leaf_count[leaf]
+        sorted_idx, left_cnt_dev = partition_rows(
+            bins_row, idx, jnp.asarray(cnt, dtype=jnp.int32), decision,
+            self.num_data)
+        left_cnt = int(left_cnt_dev)  # the one host sync per split
+        right_cnt = cnt - left_cnt
+        lp = bucket_size(left_cnt, self.min_bucket)
+        rp = bucket_size(right_cnt, self.min_bucket)
+        left_idx = sorted_idx[:lp]
+        left_idx = jnp.where(jnp.arange(lp) < left_cnt, left_idx, self.num_data)
+        # pad before slicing: dynamic_slice clamps its start index when
+        # start+size exceeds the array, which would silently hand left rows
+        # to the right child
+        padded = jnp.concatenate([
+            sorted_idx, jnp.full(rp, self.num_data, sorted_idx.dtype)])
+        right_idx = jax.lax.dynamic_slice(padded, (left_cnt,), (rp,))
+        right_idx = jnp.where(jnp.arange(rp) < right_cnt, right_idx, self.num_data)
+        self.leaf_idx[leaf] = left_idx
+        self.leaf_count[leaf] = left_cnt
+        self.leaf_idx[new_leaf] = right_idx
+        self.leaf_count[new_leaf] = right_cnt
+        return left_cnt, right_cnt
+
+    def set_used_indices(self, indices: np.ndarray) -> None:
+        """Restrict the root to a bagging subset (SetUsedDataIndices)."""
+        self.leaf_idx = {0: jnp.asarray(pad_indices(indices.astype(np.int32),
+                                                    self.num_data, self.min_bucket))}
+        self.leaf_count = {0: len(indices)}

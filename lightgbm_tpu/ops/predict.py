@@ -72,11 +72,18 @@ jax.tree_util.register_pytree_node(
 )
 
 
-def pack_ensemble(trees: Sequence[Tree], dtype=jnp.float32) -> PackedEnsemble:
-    """Pack host Tree objects into padded device tensors."""
+def pack_ensemble(trees: Sequence[Tree], dtype=jnp.float32,
+                  fixed_leaves: int = 0, fixed_depth: int = 0) -> PackedEnsemble:
+    """Pack host Tree objects into padded device tensors.
+
+    fixed_leaves / fixed_depth force the padded node count and traversal
+    depth, keeping shapes stable across repeated packs (per-iteration
+    validation scoring) so jit caches are reused.
+    """
     T = max(len(trees), 1)
-    I = max(max((t.num_leaves - 1 for t in trees), default=1), 1)
-    L = max(max((t.num_leaves for t in trees), default=1), 1)
+    I = max(max((t.num_leaves - 1 for t in trees), default=1), 1,
+            fixed_leaves - 1)
+    L = max(max((t.num_leaves for t in trees), default=1), 1, fixed_leaves)
     sf = np.zeros((T, I), dtype=np.int32)
     th = np.zeros((T, I), dtype=np.float64)
     dt = np.zeros((T, I), dtype=np.int32)
@@ -131,7 +138,7 @@ def pack_ensemble(trees: Sequence[Tree], dtype=jnp.float32) -> PackedEnsemble:
         cat_offset=jnp.asarray(co),
         cat_n_words=jnp.asarray(cw_n),
         num_leaves=jnp.asarray(nl),
-        max_depth=int(max_depth),
+        max_depth=max(int(max_depth), fixed_depth),
         num_trees=len(trees),
     )
 

@@ -1,0 +1,305 @@
+"""Vectorized best-split search over histograms.
+
+TPU-native replacement for FeatureHistogram::FindBestThreshold /
+FindBestThresholdSequentially (src/treelearner/feature_histogram.hpp:165,832)
+and the CUDA per-(leaf,feature) scan kernels (CUDABestSplitFinder,
+src/treelearner/cuda/cuda_best_split_finder.cu).
+
+Instead of the reference's per-feature sequential bidirectional scans, the
+whole search is one fused computation over a dense [F, Bmax, 3] feature-
+histogram tensor:
+
+    cumsum over bins -> left/right aggregates for every threshold
+    -> regularized gains for both missing directions -> masked argmax.
+
+Missing-value directionality (the reference's templated REVERSE / NA_AS_MISSING
+scan variants) becomes two gain lanes: the missing bin's mass (NaN bin for
+MissingType::NaN, default/zero bin for MissingType::Zero) is pulled out of the
+ordered scan and added to the left side in the "default-left" lane only.
+
+Bundled features (EFB) omit their default bin in group storage; it is
+reconstructed here from the leaf totals exactly like Dataset::FixHistogram
+(include/LightGBM/dataset.h:770).
+
+Gain/output formulas mirror feature_histogram.hpp GetSplitGains /
+CalculateSplittedLeafOutput: L1 soft-thresholding, L2 shrinkage,
+max_delta_step clamping.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+K_EPSILON = 1e-15
+K_MIN_GAIN = -np.inf
+
+
+@dataclass
+class FeatureMeta:
+    """Device-side per-feature split metadata, precomputed once per dataset."""
+
+    gather_index: jax.Array  # [F, Bmax] int32 into flattened group-hist rows (+ sentinel)
+    valid_slot: jax.Array  # [F, Bmax] bool
+    default_bin: jax.Array  # [F] int32 zero/default bin (feature-bin space)
+    efb_omitted: jax.Array  # [F] bool: default bin omitted in storage (EFB bundle)
+    missing_type: jax.Array  # [F] int32
+    nbins: jax.Array  # [F] int32 bins per feature
+    is_categorical: jax.Array  # [F] bool
+    monotone: jax.Array  # [F] int32 (-1/0/+1)
+    penalty: jax.Array  # [F] float32 per-feature split gain penalty (CEGB lazy)
+    # host-side
+    real_feature: List[int]  # dense idx -> original feature index
+    max_bins: int
+    hist_rows: int  # rows in the flattened group-hist (without sentinel)
+
+    def tree_flatten(self):
+        return ((self.gather_index, self.valid_slot, self.default_bin,
+                 self.efb_omitted, self.missing_type, self.nbins,
+                 self.is_categorical, self.monotone, self.penalty),
+                (self.real_feature, self.max_bins, self.hist_rows))
+
+
+jax.tree_util.register_pytree_node(
+    FeatureMeta,
+    FeatureMeta.tree_flatten,
+    lambda aux, ch: FeatureMeta(*ch, real_feature=aux[0], max_bins=aux[1],
+                                hist_rows=aux[2]),
+)
+
+
+def make_feature_meta(dataset, group_bin_padded: int) -> FeatureMeta:
+    """Build FeatureMeta from a constructed io.dataset.Dataset.
+
+    group_bin_padded is the per-group bin-axis padding used by the histogram
+    kernel (hist shape [G, group_bin_padded, 3]); the flat row index of group
+    g bin b is g * group_bin_padded + b.
+    """
+    feats = dataset.used_features
+    F = len(feats)
+    Bmax = max((dataset.mappers[f].num_bin for f in feats), default=2)
+    gather = np.zeros((F, Bmax), dtype=np.int32)
+    valid = np.zeros((F, Bmax), dtype=bool)
+    default_bin = np.zeros(F, dtype=np.int32)
+    efb_omitted = np.zeros(F, dtype=bool)
+    missing = np.zeros(F, dtype=np.int32)
+    nbins = np.zeros(F, dtype=np.int32)
+    is_cat = np.zeros(F, dtype=bool)
+    mono = np.zeros(F, dtype=np.int32)
+    penalty = np.zeros(F, dtype=np.float32)
+    G = dataset.num_groups
+    sentinel = G * group_bin_padded  # flat index of the all-zero sentinel row
+    for k, f in enumerate(feats):
+        m = dataset.mappers[f]
+        gi, mi = dataset.feature_to_group[f]
+        fg = dataset.groups[gi]
+        nb = m.num_bin
+        nbins[k] = nb
+        missing[k] = m.missing_type
+        is_cat[k] = m.bin_type == 1
+        if dataset.monotone_constraints:
+            mono[k] = dataset.monotone_constraints[f]
+        lo, hi, dbin = fg.feature_bin_range(mi)
+        gather[k, :] = sentinel
+        default_bin[k] = m.default_bin
+        if not fg.is_multi:
+            for b in range(nb):
+                gather[k, b] = gi * group_bin_padded + b
+                valid[k, b] = True
+        else:
+            # bundle member: natural bin b != default lives at
+            # lo + b - (b > default); default bin is reconstructed
+            for b in range(nb):
+                valid[k, b] = True
+                if b == dbin:
+                    continue
+                slot = lo + b - (1 if b > dbin else 0)
+                gather[k, b] = gi * group_bin_padded + slot
+            efb_omitted[k] = True
+    return FeatureMeta(
+        gather_index=jnp.asarray(gather),
+        valid_slot=jnp.asarray(valid),
+        default_bin=jnp.asarray(default_bin),
+        efb_omitted=jnp.asarray(efb_omitted),
+        missing_type=jnp.asarray(missing),
+        nbins=jnp.asarray(nbins),
+        is_categorical=jnp.asarray(is_cat),
+        monotone=jnp.asarray(mono),
+        penalty=jnp.asarray(penalty),
+        real_feature=list(feats),
+        max_bins=Bmax,
+        hist_rows=G * group_bin_padded,
+    )
+
+
+def threshold_l1(s, l1):
+    return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
+
+
+def leaf_output(sum_grad, sum_hess, l1, l2, max_delta_step):
+    """CalculateSplittedLeafOutput (feature_histogram.hpp)."""
+    num = -threshold_l1(sum_grad, l1)
+    out = num / jnp.maximum(sum_hess + l2, K_EPSILON)
+    return jnp.where(max_delta_step > 0,
+                     jnp.clip(out, -max_delta_step, max_delta_step), out)
+
+
+def leaf_gain_given_output(sum_grad, sum_hess, l1, l2, output):
+    g = threshold_l1(sum_grad, l1)
+    return -(2.0 * g * output + (sum_hess + l2) * output * output)
+
+
+def leaf_gain(sum_grad, sum_hess, l1, l2, max_delta_step):
+    out = leaf_output(sum_grad, sum_hess, l1, l2, max_delta_step)
+    return leaf_gain_given_output(sum_grad, sum_hess, l1, l2, out)
+
+
+# Packed best-split record layout (device -> host, one sync per leaf):
+SPLIT_FIELDS = ["gain", "feature", "threshold_bin", "default_left",
+                "left_sum_g", "left_sum_h", "left_count",
+                "right_sum_g", "right_sum_h", "right_count",
+                "left_output", "right_output"]
+
+
+@dataclass
+class SplitInfo:
+    """Host-side split record (counterpart of split_info.hpp SplitInfo)."""
+
+    gain: float = -np.inf
+    feature: int = -1  # dense (used-feature) index
+    threshold_bin: int = 0
+    default_left: bool = False
+    left_sum_g: float = 0.0
+    left_sum_h: float = 0.0
+    left_count: int = 0
+    right_sum_g: float = 0.0
+    right_sum_h: float = 0.0
+    right_count: int = 0
+    left_output: float = 0.0
+    right_output: float = 0.0
+    is_categorical: bool = False
+    cat_bitset_bins: Optional[List[int]] = None  # bin-space bitset words
+
+    @property
+    def valid(self) -> bool:
+        return self.feature >= 0 and np.isfinite(self.gain) and self.gain > 0
+
+    @classmethod
+    def from_packed(cls, vec: np.ndarray) -> "SplitInfo":
+        return cls(gain=float(vec[0]), feature=int(vec[1]),
+                   threshold_bin=int(vec[2]), default_left=bool(vec[3] > 0.5),
+                   left_sum_g=float(vec[4]), left_sum_h=float(vec[5]),
+                   left_count=int(round(vec[6])), right_sum_g=float(vec[7]),
+                   right_sum_h=float(vec[8]), right_count=int(round(vec[9])),
+                   left_output=float(vec[10]), right_output=float(vec[11]))
+
+
+@partial(jax.jit, static_argnames=())
+def gather_feature_hist(hist: jax.Array, meta: FeatureMeta,
+                        totals: jax.Array) -> jax.Array:
+    """[G, Bg, 3] group hist -> [F, Bmax, 3] feature hist with EFB default
+    reconstruction (FixHistogram)."""
+    flat = hist.reshape(-1, hist.shape[-1])
+    flat = jnp.concatenate([flat, jnp.zeros((1, hist.shape[-1]), flat.dtype)], axis=0)
+    fh = flat[meta.gather_index]  # [F, Bmax, 3]
+    fh = fh * meta.valid_slot[:, :, None]
+    # EFB default-bin reconstruction: default = leaf totals - sum(other bins)
+    missing_mass = totals[None, :] - fh.sum(axis=1)  # [F, 3]
+    add = jnp.where(meta.efb_omitted[:, None], missing_mass, 0.0)
+    fh = fh.at[jnp.arange(fh.shape[0]), meta.default_bin].add(add)
+    return fh
+
+
+@partial(jax.jit, static_argnames=())
+def find_best_split(hist: jax.Array, totals: jax.Array, meta: FeatureMeta,
+                    params: jax.Array) -> jax.Array:
+    """Best numerical split across all features for one leaf.
+
+    hist:   [G, Bg, 3] group histogram for the leaf
+    totals: [3] leaf (sum_grad, sum_hess, count)
+    params: [lambda_l1, lambda_l2, min_data_in_leaf, min_sum_hessian_in_leaf,
+             min_gain_to_split, max_delta_step] as a device vector
+    Returns packed split record [len(SPLIT_FIELDS)] float32.
+    """
+    l1, l2, min_data, min_hess, min_gain, max_delta = (
+        params[0], params[1], params[2], params[3], params[4], params[5])
+    fh = gather_feature_hist(hist, meta, totals)  # [F, Bmax, 3]
+    F, Bmax, _ = fh.shape
+
+    total_g, total_h, total_cnt = totals[0], totals[1], totals[2]
+
+    # pull the missing bin out of the ordered scan: the NaN bin is the last
+    # bin for MissingType::NaN, the zero/default bin for MissingType::Zero
+    missing_pos = jnp.where(meta.missing_type == MISSING_NAN,
+                            meta.nbins - 1, meta.default_bin)
+    has_missing = meta.missing_type != MISSING_NONE
+    rows = jnp.arange(F)
+    missing_vals = jnp.where(has_missing[:, None],
+                             fh[rows, missing_pos], 0.0)  # [F, 3]
+    scan_hist = jnp.where(
+        (has_missing[:, None] & (jnp.arange(Bmax)[None, :] == missing_pos[:, None]))[:, :, None],
+        0.0, fh)
+
+    cum = jnp.cumsum(scan_hist, axis=1)  # [F, Bmax, 3]
+
+    # lane 0: missing goes right (natural);  lane 1: missing goes left
+    left0 = cum
+    left1 = cum + missing_vals[:, None, :]
+    results = []
+    for lane, left in enumerate((left0, left1)):
+        lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
+        rg, rh, rc = total_g - lg, total_h - lh, total_cnt - lc
+        ok = (lc >= min_data) & (rc >= min_data) & \
+             (lh >= min_hess) & (rh >= min_hess)
+        # threshold t must leave at least one real bin on the right
+        tpos = jnp.arange(Bmax)[None, :]
+        ok &= tpos < (meta.nbins[:, None] - 1)
+        ok &= meta.valid_slot
+        ok &= ~meta.is_categorical[:, None]
+        if lane == 1:
+            ok &= has_missing[:, None]
+        gain = (leaf_gain(lg, lh, l1, l2, max_delta)
+                + leaf_gain(rg, rh, l1, l2, max_delta))
+        gain = jnp.where(ok, gain, -jnp.inf)
+        results.append((gain, lg, lh, lc, rg, rh, rc))
+
+    gain_shift = leaf_gain(total_g, total_h, l1, l2, max_delta) + min_gain
+    g0, g1 = results[0][0], results[1][0]
+    both = jnp.stack([g0, g1])  # [2, F, Bmax]
+    flat_idx = jnp.argmax(both)
+    lane_b = flat_idx // (F * Bmax)
+    rem = flat_idx % (F * Bmax)
+    f_b = rem // Bmax
+    t_b = rem % Bmax
+    best_gain = both.reshape(-1)[flat_idx]
+
+    def pick(a0, a1):
+        stack = jnp.stack([a0, a1])
+        return stack[lane_b, f_b, t_b]
+
+    lg = pick(results[0][1], results[1][1])
+    lh = pick(results[0][2], results[1][2])
+    lc = pick(results[0][3], results[1][3])
+    rg = pick(results[0][4], results[1][4])
+    rh = pick(results[0][5], results[1][5])
+    rc = pick(results[0][6], results[1][6])
+
+    is_valid = jnp.isfinite(best_gain) & (best_gain > gain_shift)
+    out_gain = jnp.where(is_valid, best_gain - gain_shift, -jnp.inf)
+    lout = leaf_output(lg, lh, l1, l2, max_delta)
+    rout = leaf_output(rg, rh, l1, l2, max_delta)
+    # default_left lane semantics: lane 1 sends the missing bin left
+    rec = jnp.stack([
+        out_gain,
+        jnp.where(is_valid, f_b.astype(jnp.float32), -1.0),
+        t_b.astype(jnp.float32),
+        lane_b.astype(jnp.float32),
+        lg, lh, lc, rg, rh, rc, lout, rout,
+    ])
+    return rec

@@ -1,0 +1,137 @@
+"""SHAP feature contributions (TreeSHAP).
+
+Counterpart of the reference's PredictContrib path (gbdt_prediction.cpp:99,
+Tree SHAP recursion in src/io/tree.cpp). Full polynomial-time TreeSHAP is
+implemented on host over the tree arrays; output layout matches the reference:
+[N, F+1] with the expected value in the last column (per class blocks for
+multiclass).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .models.tree import Tree
+
+
+def _tree_shap(tree: Tree, row: np.ndarray, phi: np.ndarray) -> None:
+    """Exact TreeSHAP (Lundberg et al. 2018 'Consistent Individualized
+    Feature Attribution for Tree Ensembles') over one tree."""
+    if tree.num_leaves <= 1:
+        return
+
+    class PathElem:
+        __slots__ = ("d", "zero", "one", "pweight")
+
+        def __init__(self, d, zero, one, pweight):
+            self.d = d
+            self.zero = zero
+            self.one = one
+            self.pweight = pweight
+
+    def extend(path: List[PathElem], zero: float, one: float, d: int):
+        path.append(PathElem(d, zero, one, 1.0 if len(path) == 0 else 0.0))
+        n = len(path)
+        for i in range(n - 2, -1, -1):
+            path[i + 1].pweight += one * path[i].pweight * (i + 1) / n
+            path[i].pweight = zero * path[i].pweight * (n - 1 - i) / n
+
+    def unwind(path: List[PathElem], i: int):
+        n = len(path)
+        one = path[i].one
+        zero = path[i].zero
+        nxt = path[n - 1].pweight
+        for j in range(n - 2, -1, -1):
+            if one != 0:
+                tmp = path[j].pweight
+                path[j].pweight = nxt * n / ((j + 1) * one)
+                nxt = tmp - path[j].pweight * zero * (n - 1 - j) / n
+            else:
+                path[j].pweight = path[j].pweight * n / (zero * (n - 1 - j))
+        for j in range(i, n - 1):
+            path[j].d = path[j + 1].d
+            path[j].zero = path[j + 1].zero
+            path[j].one = path[j + 1].one
+        path.pop()
+
+    def unwound_sum(path: List[PathElem], i: int) -> float:
+        n = len(path)
+        one = path[i].one
+        zero = path[i].zero
+        total = 0.0
+        nxt = path[n - 1].pweight
+        for j in range(n - 2, -1, -1):
+            if one != 0:
+                tmp = nxt * n / ((j + 1) * one)
+                total += tmp
+                nxt = path[j].pweight - tmp * zero * ((n - 1 - j) / n)
+            else:
+                total += path[j].pweight / (zero * ((n - 1 - j) / n))
+        return total
+
+    def node_weight(node: int) -> float:
+        if node < 0:
+            return float(tree.leaf_count[~node])
+        return float(tree.internal_count[node])
+
+    def recurse(node: int, path: List[PathElem], zero: float, one: float, pfeat: int):
+        path = [PathElem(p.d, p.zero, p.one, p.pweight) for p in path]
+        extend(path, zero, one, pfeat)
+        if node < 0:
+            leaf = ~node
+            for i in range(1, len(path)):
+                w = unwound_sum(path, i)
+                phi[path[i].d] += w * (path[i].one - path[i].zero) * tree.leaf_value[leaf]
+            return
+        feat = int(tree.split_feature[node])
+        # hot/cold child by the decision
+        nxt = tree._decide_categorical(float(row[feat]), node) \
+            if int(tree.decision_type[node]) & 1 else \
+            tree._decide_numerical(float(row[feat]), node)
+        hot = nxt
+        cold = int(tree.right_child[node]) if hot == int(tree.left_child[node]) \
+            else int(tree.left_child[node])
+        w = node_weight(node)
+        hot_frac = node_weight(hot) / w if w > 0 else 0.0
+        cold_frac = node_weight(cold) / w if w > 0 else 0.0
+        incoming_zero, incoming_one = 1.0, 1.0
+        path_index = next((i for i in range(len(path)) if path[i].d == feat), -1)
+        if path_index >= 0:
+            incoming_zero = path[path_index].zero
+            incoming_one = path[path_index].one
+            unwind(path, path_index)
+        recurse(hot, path, incoming_zero * hot_frac, incoming_one, feat)
+        recurse(cold, path, incoming_zero * cold_frac, 0.0, feat)
+
+    recurse(0, [], 1.0, 1.0, -1)
+
+
+def predict_contrib(trees: List[Tree], X: np.ndarray,
+                    num_tree_per_iteration: int = 1,
+                    num_iteration: int = 0) -> np.ndarray:
+    n, f = X.shape
+    n_trees = len(trees)
+    if num_iteration > 0:
+        n_trees = min(n_trees, num_iteration * num_tree_per_iteration)
+    C = num_tree_per_iteration
+    out = np.zeros((n, C * (f + 1)), dtype=np.float64)
+    for t_idx in range(n_trees):
+        tree = trees[t_idx]
+        c = t_idx % C
+        base = c * (f + 1)
+        expected = tree.expected_value()
+        for i in range(n):
+            phi = np.zeros(f + 1)
+            phi_feat = np.zeros(f + 1)
+
+            class _Phi:
+                pass
+
+            arr = np.zeros(f)
+            _tree_shap(tree, X[i], arr)
+            out[i, base: base + f] += arr
+            out[i, base + f] += expected
+    if C == 1:
+        return out
+    return out

@@ -1,0 +1,213 @@
+"""Leaf-wise (best-first) tree learner on TPU.
+
+Counterpart of SerialTreeLearner (src/treelearner/serial_tree_learner.cpp:182+)
+with the execution structure of the CUDA single-GPU learner
+(src/treelearner/cuda/cuda_single_gpu_tree_learner.cpp:169-360): the leaf-wise
+loop runs on host, each step dispatching three fused device computations —
+
+  1. leaf histogram           (ops/histogram.py — one-hot MXU contraction)
+  2. best-split search        (ops/split.py — cumsum + masked argmax)
+  3. partition update         (ops/partition.py — stable-sort compaction)
+
+with the histogram-subtraction trick (larger child = parent − smaller,
+feature_histogram.hpp:99) and one device→host sync per split (the packed
+best-split record), exactly the CUDA learner's sync budget.
+
+Histograms are cached per leaf (the HistogramPool analog — device arrays held
+by the frontier map; LRU capping arrives with histogram_pool_size support).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..io.dataset import Dataset
+from ..models.tree import Tree
+from ..ops.histogram import build_histogram_rows, subtract_histogram
+from ..ops.partition import RowPartition
+from ..ops.split import (FeatureMeta, SplitInfo, find_best_split,
+                         make_feature_meta)
+from ..utils.log import Log
+from ..utils.timer import global_timer
+
+
+@dataclass
+class _LeafState:
+    hist: Optional[jax.Array]  # [G, B, 3] leaf histogram
+    totals: Tuple[float, float, float]  # (sum_g, sum_h, count)
+    split: Optional[SplitInfo]
+    depth: int
+
+
+class SerialTreeLearner:
+    def __init__(self, config: Config, dataset: Dataset) -> None:
+        self.config = config
+        self.dataset = dataset
+        self.num_data = dataset.num_data
+        # device-resident bin matrix (the CUDARowData analog)
+        self.bins_dev = jnp.asarray(dataset.bins)
+        self.group_bin_padded = int(max(dataset.group_bin_counts().max(), 2))
+        self.meta: FeatureMeta = make_feature_meta(dataset, self.group_bin_padded)
+        self.params_dev = jnp.asarray([
+            config.lambda_l1, config.lambda_l2,
+            float(config.min_data_in_leaf), config.min_sum_hessian_in_leaf,
+            config.min_gain_to_split, config.max_delta_step,
+        ], dtype=jnp.float32)
+        self.partition: Optional[RowPartition] = None
+
+    # ------------------------------------------------------------------ train
+
+    def train(self, gh_ext: jax.Array,
+              bag_indices: Optional[np.ndarray] = None) -> Tree:
+        """Grow one tree from extended gradients gh_ext [N+1, 3]
+        (zero sentinel row at N)."""
+        cfg = self.config
+        num_leaves = cfg.num_leaves
+        tree = Tree(num_leaves)
+        partition = RowPartition(self.num_data)
+        if bag_indices is not None:
+            partition.set_used_indices(bag_indices)
+        self.partition = partition
+
+        frontier: Dict[int, _LeafState] = {}
+        with global_timer.scope("hist_root"):
+            root_hist = build_histogram_rows(
+                self.bins_dev, gh_ext, partition.indices(0), self.group_bin_padded)
+        root_totals_dev = root_hist[0].sum(axis=0)
+        root_totals = tuple(float(x) for x in np.asarray(root_totals_dev))
+        frontier[0] = _LeafState(root_hist, root_totals, None, depth=0)
+        self._find_split(frontier, 0)
+
+        for _ in range(num_leaves - 1):
+            best_leaf, best = None, None
+            for leaf, state in frontier.items():
+                if state.split is not None and state.split.valid:
+                    if best is None or state.split.gain > best.gain:
+                        best_leaf, best = leaf, state.split
+            if best_leaf is None:
+                Log.debug("No further splits with positive gain, best gain: -inf")
+                break
+            self._apply_split(tree, frontier, best_leaf, best, gh_ext)
+            if tree.num_leaves >= num_leaves:
+                break
+
+        # leaf outputs: already set by _apply_split; root-only tree handled
+        if tree.num_leaves == 1:
+            tree.as_constant_tree(0.0)
+        self._last_frontier = frontier
+        return tree
+
+    # --------------------------------------------------------------- internal
+
+    def _max_depth_ok(self, depth: int) -> bool:
+        return self.config.max_depth <= 0 or depth < self.config.max_depth
+
+    def _find_split(self, frontier: Dict[int, _LeafState], leaf: int) -> None:
+        state = frontier[leaf]
+        cnt = state.totals[2]
+        if (not self._max_depth_ok(state.depth)
+                or cnt < 2 * self.config.min_data_in_leaf
+                or state.totals[1] < 2 * self.config.min_sum_hessian_in_leaf):
+            state.split = SplitInfo()
+            return
+        with global_timer.scope("find_best_split"):
+            rec = find_best_split(
+                state.hist, jnp.asarray(state.totals, dtype=jnp.float32),
+                self.meta, self.params_dev)
+            state.split = SplitInfo.from_packed(np.asarray(rec))
+
+    def _apply_split(self, tree: Tree, frontier: Dict[int, _LeafState],
+                     leaf: int, split: SplitInfo, gh_ext: jax.Array) -> None:
+        ds = self.dataset
+        meta = self.meta
+        dense_f = split.feature
+        real_f = meta.real_feature[dense_f]
+        mapper = ds.mappers[real_f]
+        gi, mi = ds.feature_to_group[real_f]
+        fg = ds.groups[gi]
+        lo, hi, dbin = fg.feature_bin_range(mi)
+
+        state = frontier[leaf]
+        new_leaf = tree.num_leaves
+
+        # 1. record the split in the tree (real-value threshold)
+        threshold_double = mapper.bin_to_value(split.threshold_bin)
+        parent_output = _leaf_output_host(
+            state.totals[0], state.totals[1],
+            self.config.lambda_l1, self.config.lambda_l2,
+            self.config.max_delta_step)
+        tree.split(leaf=leaf, feature_inner=dense_f, real_feature=real_f,
+                   threshold_bin=split.threshold_bin,
+                   threshold_double=threshold_double,
+                   default_left=split.default_left,
+                   missing_type=mapper.missing_type,
+                   gain=split.gain,
+                   left_value=split.left_output, right_value=split.right_output,
+                   left_count=split.left_count, right_count=split.right_count,
+                   left_weight=split.left_sum_h, right_weight=split.right_sum_h,
+                   parent_value=parent_output)
+
+        # 2. partition rows (one host sync for the left count)
+        decision = jnp.asarray([
+            float(split.threshold_bin), 1.0 if split.default_left else 0.0,
+            float(mapper.missing_type), float(mapper.default_bin),
+            float(mapper.num_bin), float(lo), float(hi),
+            1.0 if fg.is_multi else 0.0,
+        ], dtype=jnp.float32)
+        with global_timer.scope("partition"):
+            left_cnt, right_cnt = self.partition.split(
+                leaf, new_leaf, self.bins_dev[gi], decision)
+        if left_cnt != split.left_count or right_cnt != split.right_count:
+            Log.debug("Partition count mismatch at leaf %d: %d/%d vs %d/%d",
+                      leaf, left_cnt, right_cnt, split.left_count, split.right_count)
+
+        # 3. child histograms: construct the smaller, subtract for the larger
+        parent_hist = state.hist
+        left_totals = (split.left_sum_g, split.left_sum_h, float(left_cnt))
+        right_totals = (split.right_sum_g, split.right_sum_h, float(right_cnt))
+        with global_timer.scope("hist_children"):
+            if left_cnt <= right_cnt:
+                small, big = leaf, new_leaf
+                small_tot, big_tot = left_totals, right_totals
+            else:
+                small, big = new_leaf, leaf
+                small_tot, big_tot = right_totals, left_totals
+            small_hist = build_histogram_rows(
+                self.bins_dev, gh_ext, self.partition.indices(small),
+                self.group_bin_padded)
+            big_hist = subtract_histogram(parent_hist, small_hist)
+        depth = state.depth + 1
+        frontier[leaf] = _LeafState(
+            small_hist if small == leaf else big_hist, left_totals, None, depth)
+        frontier[new_leaf] = _LeafState(
+            small_hist if small == new_leaf else big_hist, right_totals, None, depth)
+        state.hist = None  # release parent histogram
+        self._find_split(frontier, leaf)
+        self._find_split(frontier, new_leaf)
+
+
+def _leaf_output_host(sum_g: float, sum_h: float, l1: float, l2: float,
+                      max_delta: float) -> float:
+    num = -np.sign(sum_g) * max(abs(sum_g) - l1, 0.0)
+    out = num / max(sum_h + l2, 1e-15)
+    if max_delta > 0:
+        out = float(np.clip(out, -max_delta, max_delta))
+    return float(out)
+
+
+def create_tree_learner(learner_type: str, device_type: str, config: Config,
+                        dataset: Dataset):
+    """Factory (tree_learner.cpp:17-57). Distributed learners (feature/data/
+    voting) are built on the parallel backend in parallel/."""
+    if learner_type in ("serial",):
+        return SerialTreeLearner(config, dataset)
+    if learner_type in ("feature", "data", "voting"):
+        from ..parallel.learners import create_parallel_learner
+
+        return create_parallel_learner(learner_type, config, dataset)
+    Log.fatal("Unknown tree learner type: %s", learner_type)

@@ -1,0 +1,64 @@
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def make_blobs(n=1500, k=4, f=6, seed=5):
+    rng = np.random.RandomState(seed)
+    centers = rng.normal(scale=3.0, size=(k, f))
+    y = rng.randint(0, k, n)
+    X = centers[y] + rng.normal(size=(n, f))
+    return X, y.astype(np.float64)
+
+
+def test_multiclass_softmax():
+    X, y = make_blobs()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "multiclass", "num_class": 4, "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=15)
+    pred = bst.predict(X)
+    assert pred.shape == (len(X), 4)
+    np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-4)
+    acc = (pred.argmax(axis=1) == y).mean()
+    assert acc > 0.95, f"accuracy {acc}"
+    assert bst.num_trees() == 15 * 4
+
+
+def test_multiclass_ova():
+    X, y = make_blobs(800, 3)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "multiclassova", "num_class": 3, "num_leaves": 7,
+                     "verbosity": -1}, ds, num_boost_round=10)
+    pred = bst.predict(X)
+    acc = (pred.argmax(axis=1) == y).mean()
+    assert acc > 0.92, f"accuracy {acc}"
+
+
+def test_multiclass_metrics_and_model_roundtrip(tmp_path):
+    X, y = make_blobs(900, 3)
+    ds = lgb.Dataset(X, label=y)
+    rec = {}
+    dv = lgb.Dataset(X[:200], label=y[:200], reference=ds)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "metric": "multi_logloss,multi_error", "num_leaves": 7,
+                     "verbosity": -1}, ds, num_boost_round=8,
+                    valid_sets=[dv], callbacks=[lgb.record_evaluation(rec)])
+    assert rec["valid_0"]["multi_logloss"][-1] < rec["valid_0"]["multi_logloss"][0]
+    assert rec["valid_0"]["multi_error"][-1] < 0.2
+    path = str(tmp_path / "mc.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    p1, p2 = bst.predict(X[:50]), bst2.predict(X[:50])
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_xentropy():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(1200, 5))
+    p_true = 1.0 / (1.0 + np.exp(-(X[:, 0] - X[:, 1])))
+    ds = lgb.Dataset(X, label=p_true)
+    bst = lgb.train({"objective": "cross_entropy", "num_leaves": 15, "verbosity": -1},
+                    ds, num_boost_round=30)
+    pred = bst.predict(X)
+    assert np.corrcoef(pred, p_true)[0, 1] > 0.97

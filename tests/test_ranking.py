@@ -1,0 +1,77 @@
+"""Ranking tests on the reference's examples/lambdarank data."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+RANK_TRAIN = "/root/reference/examples/lambdarank/rank.train"
+RANK_TEST = "/root/reference/examples/lambdarank/rank.test"
+
+
+def test_lambdarank_reference_example():
+    ds = lgb.Dataset(RANK_TRAIN)
+    dv = lgb.Dataset(RANK_TEST, reference=ds)
+    rec = {}
+    bst = lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                     "eval_at": "1,3,5", "num_leaves": 31, "learning_rate": 0.1,
+                     "verbosity": -1, "min_data_in_leaf": 50, "min_sum_hessian_in_leaf": 5.0},
+                    ds, num_boost_round=30, valid_sets=[dv],
+                    callbacks=[lgb.record_evaluation(rec)])
+    ndcg5 = rec["valid_0"]["ndcg@5"]
+    assert ndcg5[-1] > 0.55, f"ndcg@5 too low: {ndcg5[-1]}"
+    assert ndcg5[-1] > ndcg5[0] - 0.02  # learning, not diverging
+
+
+def test_rank_xendcg():
+    ds = lgb.Dataset(RANK_TRAIN)
+    rec = {}
+    dv = lgb.Dataset(RANK_TEST, reference=ds)
+    bst = lgb.train({"objective": "rank_xendcg", "metric": "ndcg", "eval_at": "5",
+                     "num_leaves": 31, "verbosity": -1, "min_data_in_leaf": 1,
+                     "min_sum_hessian_in_leaf": 1e-3},
+                    ds, num_boost_round=20, valid_sets=[dv],
+                    callbacks=[lgb.record_evaluation(rec)])
+    assert rec["valid_0"]["ndcg@5"][-1] > 0.5
+
+
+def test_ndcg_metric_values():
+    # hand-computable case: one query, 4 docs
+    from lightgbm_tpu.metrics import create_metric
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.metadata import Metadata
+    import jax.numpy as jnp
+
+    md = Metadata(4)
+    md.set_label(np.array([3.0, 2.0, 1.0, 0.0]))
+    md.set_query(np.array([4]))
+    cfg = Config({"eval_at": "2,4"})
+    m = create_metric("ndcg", cfg)
+    m.init(md, 4)
+    # perfect ranking
+    perfect = m.eval(jnp.asarray([4.0, 3.0, 2.0, 1.0]), None)
+    assert perfect[0] == pytest.approx(1.0, abs=1e-6)
+    assert perfect[1] == pytest.approx(1.0, abs=1e-6)
+    # reversed ranking
+    rev = m.eval(jnp.asarray([1.0, 2.0, 3.0, 4.0]), None)
+    assert rev[0] < 0.3
+    g = [0, 1, 3, 7]
+    disc = 1.0 / np.log2(np.arange(4) + 2.0)
+    dcg_rev = np.sum(np.array([g[0], g[1], g[2], g[3]]) * disc)
+    max_dcg = np.sum(np.array([g[3], g[2], g[1], g[0]]) * disc)
+    assert rev[1] == pytest.approx(dcg_rev / max_dcg, abs=1e-5)
+
+
+def test_map_metric():
+    from lightgbm_tpu.metrics import create_metric
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.metadata import Metadata
+    import jax.numpy as jnp
+
+    md = Metadata(4)
+    md.set_label(np.array([1.0, 0.0, 1.0, 0.0]))
+    md.set_query(np.array([4]))
+    m = create_metric("map", Config({"eval_at": "4"}))
+    m.init(md, 4)
+    # ranking: rel, not, rel, not -> AP = (1/1 + 2/3)/2
+    val = m.eval(jnp.asarray([4.0, 3.0, 2.0, 1.0]), None)
+    assert val[0] == pytest.approx((1.0 + 2.0 / 3.0) / 2.0, abs=1e-6)

@@ -35,7 +35,7 @@ for line in lines:
         continue
     m = re.match(
         r'(std::string|std::vector<std::string>|std::vector<double>|std::vector<int>|'
-        r'std::vector<int8_t>|double|float|int|int64_t|size_t|bool|data_size_t)\s+(\w+)\s*(?:=\s*(.*?))?;\s*$',
+        r'std::vector<int8_t>|std::vector<int32_t>|double|float|int|int64_t|size_t|bool|data_size_t)\s+(\w+)\s*(?:=\s*(.*?))?;\s*$',
         s)
     if m:
         ctype, name, default = m.groups()
@@ -55,7 +55,8 @@ for line in lines:
 
 PYTYPE = {'std::string': 'str', 'std::vector<std::string>': 'list_str',
           'std::vector<double>': 'list_float', 'std::vector<int>': 'list_int',
-          'std::vector<int8_t>': 'list_int', 'double': 'float', 'float': 'float',
+          'std::vector<int8_t>': 'list_int',
+          'std::vector<int32_t>': 'list_int', 'double': 'float', 'float': 'float',
           'int': 'int', 'int64_t': 'int', 'size_t': 'int', 'bool': 'bool',
           'data_size_t': 'int'}
 SYMBOLIC = {'kDefaultNumLeaves': 31, 'size_t(10) * 1024 * 1024 * 1024': 10737418240}
