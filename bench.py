@@ -1,0 +1,64 @@
+"""Training-throughput benchmark vs the reference's HIGGS baseline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Reference anchor (BASELINE.md): LightGBM CPU trains HIGGS — 10.5M rows x 28
+features, 500 iterations, 255 leaves — in 130.094 s (docs/Experiments.rst:113),
+i.e. 10.5e6 * 500 / 130.094 = 40.36M row-iterations/second. HIGGS itself
+cannot be downloaded in this sandbox (zero egress), so the bench trains on a
+synthetic dataset with the HIGGS shape profile (28 dense numerical features,
+binary labels, max_bin=255, num_leaves=255) and reports the same
+row-iterations/second measure; vs_baseline = ours / 40.36e6 (>1 is faster).
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+N_FEATURES = 28
+N_ITERS = int(os.environ.get("BENCH_ITERS", 20))
+WARMUP_ITERS = 2
+BASELINE_ROW_ITERS_PER_SEC = 10_500_000 * 500 / 130.094
+
+
+def main() -> None:
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(42)
+    X = rng.randn(N_ROWS, N_FEATURES).astype(np.float32)
+    w = rng.randn(N_FEATURES)
+    logit = X[:5_000_000] @ w  # cap the label-gen matmul cost
+    if N_ROWS > logit.shape[0]:
+        logit = np.concatenate([logit, X[5_000_000:] @ w])
+    y = (logit + rng.randn(N_ROWS).astype(np.float32) > 0).astype(np.float64)
+
+    params = {
+        "objective": "binary",
+        "num_leaves": 255,
+        "learning_rate": 0.1,
+        "max_bin": 255,
+        "min_data_in_leaf": 100,
+        "verbosity": -1,
+    }
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params=params, train_set=ds)
+    for _ in range(WARMUP_ITERS):  # compile + cache warmup, not timed
+        bst.update()
+    t0 = time.perf_counter()
+    for _ in range(N_ITERS):
+        bst.update()
+    elapsed = time.perf_counter() - t0
+
+    row_iters_per_sec = N_ROWS * N_ITERS / elapsed
+    print(json.dumps({
+        "metric": "train_row_iters_per_sec",
+        "value": round(row_iters_per_sec, 1),
+        "unit": "row_iters/s",
+        "vs_baseline": round(row_iters_per_sec / BASELINE_ROW_ITERS_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,16 @@ def run(argv: List[str]) -> int:
     config = Config(params)
     set_verbosity(config.verbosity)
 
+    if config.device_type == "cpu":
+        # select the CPU backend before any JAX computation initializes it;
+        # the hosted-TPU plugin otherwise claims the platform
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
     if task == "train":
         return _task_train(config, params)
     if task in ("predict", "prediction", "test"):

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import List, Optional, Tuple
+from typing import List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -138,6 +138,48 @@ def make_feature_meta(dataset, group_bin_padded: int) -> FeatureMeta:
     )
 
 
+class ScanMeta(NamedTuple):
+    """The FeatureMeta subset the split scan reads — a plain pytree so
+    distributed learners can shard it along the feature axis."""
+
+    valid_slot: jax.Array  # [F, Bmax] bool
+    default_bin: jax.Array  # [F] int32
+    missing_type: jax.Array  # [F] int32
+    nbins: jax.Array  # [F] int32
+    is_categorical: jax.Array  # [F] bool
+
+
+def scan_meta_of(meta: FeatureMeta) -> ScanMeta:
+    return ScanMeta(meta.valid_slot, meta.default_bin, meta.missing_type,
+                    meta.nbins, meta.is_categorical)
+
+
+def pad_feature_meta(meta: FeatureMeta, f_pad: int) -> FeatureMeta:
+    """Pad the feature axis to f_pad with inert rows (valid_slot all False,
+    gather hitting the zero sentinel) so it divides a mesh axis evenly."""
+    F = meta.gather_index.shape[0]
+    if f_pad == F:
+        return meta
+    pad = f_pad - F
+    return FeatureMeta(
+        gather_index=jnp.concatenate([
+            meta.gather_index,
+            jnp.full((pad, meta.max_bins), meta.hist_rows, jnp.int32)]),
+        valid_slot=jnp.concatenate([
+            meta.valid_slot, jnp.zeros((pad, meta.max_bins), bool)]),
+        default_bin=jnp.concatenate([meta.default_bin, jnp.zeros(pad, jnp.int32)]),
+        efb_omitted=jnp.concatenate([meta.efb_omitted, jnp.zeros(pad, bool)]),
+        missing_type=jnp.concatenate([meta.missing_type, jnp.zeros(pad, jnp.int32)]),
+        nbins=jnp.concatenate([meta.nbins, jnp.ones(pad, jnp.int32)]),
+        is_categorical=jnp.concatenate([meta.is_categorical, jnp.zeros(pad, bool)]),
+        monotone=jnp.concatenate([meta.monotone, jnp.zeros(pad, jnp.int32)]),
+        penalty=jnp.concatenate([meta.penalty, jnp.zeros(pad, jnp.float32)]),
+        real_feature=list(meta.real_feature) + [-1] * pad,
+        max_bins=meta.max_bins,
+        hist_rows=meta.hist_rows,
+    )
+
+
 def threshold_l1(s, l1):
     return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
 
@@ -216,20 +258,23 @@ def gather_feature_hist(hist: jax.Array, meta: FeatureMeta,
     return fh
 
 
-@partial(jax.jit, static_argnames=())
-def find_best_split(hist: jax.Array, totals: jax.Array, meta: FeatureMeta,
-                    params: jax.Array) -> jax.Array:
-    """Best numerical split across all features for one leaf.
+def per_feature_best(fh: jax.Array, totals: jax.Array, meta: FeatureMeta,
+                     params: jax.Array) -> jax.Array:
+    """Best split per feature: [F, len(SPLIT_FIELDS)] records.
 
-    hist:   [G, Bg, 3] group histogram for the leaf
+    fh:     [F, Bmax, 3] feature histograms (after gather_feature_hist)
     totals: [3] leaf (sum_grad, sum_hess, count)
     params: [lambda_l1, lambda_l2, min_data_in_leaf, min_sum_hessian_in_leaf,
              min_gain_to_split, max_delta_step] as a device vector
-    Returns packed split record [len(SPLIT_FIELDS)] float32.
+
+    The `feature` field is the LOCAL row index into fh (invalid rows get -1);
+    distributed feature shards offset it by their block start. This is the
+    core scan shared by the serial learner and the data/feature/voting
+    parallel learners (the reference runs FindBestThresholdSequentially per
+    rank feature block, data_parallel_tree_learner.cpp:305+).
     """
     l1, l2, min_data, min_hess, min_gain, max_delta = (
         params[0], params[1], params[2], params[3], params[4], params[5])
-    fh = gather_feature_hist(hist, meta, totals)  # [F, Bmax, 3]
     F, Bmax, _ = fh.shape
 
     total_g, total_h, total_cnt = totals[0], totals[1], totals[2]
@@ -271,17 +316,15 @@ def find_best_split(hist: jax.Array, totals: jax.Array, meta: FeatureMeta,
 
     gain_shift = leaf_gain(total_g, total_h, l1, l2, max_delta) + min_gain
     g0, g1 = results[0][0], results[1][0]
-    both = jnp.stack([g0, g1])  # [2, F, Bmax]
-    flat_idx = jnp.argmax(both)
-    lane_b = flat_idx // (F * Bmax)
-    rem = flat_idx % (F * Bmax)
-    f_b = rem // Bmax
-    t_b = rem % Bmax
-    best_gain = both.reshape(-1)[flat_idx]
+    per_f = jnp.stack([g0, g1], axis=1).reshape(F, 2 * Bmax)  # lane-major
+    best_flat = jnp.argmax(per_f, axis=1)  # [F]
+    lane_b = best_flat // Bmax
+    t_b = best_flat % Bmax
+    best_gain = jnp.take_along_axis(per_f, best_flat[:, None], axis=1)[:, 0]
 
     def pick(a0, a1):
-        stack = jnp.stack([a0, a1])
-        return stack[lane_b, f_b, t_b]
+        stack = jnp.stack([a0, a1])  # [2, F, Bmax]
+        return stack[lane_b, rows, t_b]
 
     lg = pick(results[0][1], results[1][1])
     lh = pick(results[0][2], results[1][2])
@@ -295,11 +338,30 @@ def find_best_split(hist: jax.Array, totals: jax.Array, meta: FeatureMeta,
     lout = leaf_output(lg, lh, l1, l2, max_delta)
     rout = leaf_output(rg, rh, l1, l2, max_delta)
     # default_left lane semantics: lane 1 sends the missing bin left
-    rec = jnp.stack([
+    return jnp.stack([
         out_gain,
-        jnp.where(is_valid, f_b.astype(jnp.float32), -1.0),
+        jnp.where(is_valid, rows.astype(jnp.float32), -1.0),
         t_b.astype(jnp.float32),
         lane_b.astype(jnp.float32),
         lg, lh, lc, rg, rh, rc, lout, rout,
-    ])
-    return rec
+    ], axis=1)
+
+
+def reduce_best_record(recs: jax.Array) -> jax.Array:
+    """[K, len(SPLIT_FIELDS)] -> [len(SPLIT_FIELDS)] by max gain (ties: first,
+    matching the reference's SplitInfo operator> sweep order)."""
+    return recs[jnp.argmax(recs[:, 0])]
+
+
+@partial(jax.jit, static_argnames=())
+def find_best_split(hist: jax.Array, totals: jax.Array, meta: FeatureMeta,
+                    params: jax.Array) -> jax.Array:
+    """Best numerical split across all features for one leaf.
+
+    hist:   [G, Bg, 3] group histogram for the leaf
+    totals: [3] leaf (sum_grad, sum_hess, count)
+    Returns packed split record [len(SPLIT_FIELDS)] float32.
+    """
+    fh = gather_feature_hist(hist, meta, totals)  # [F, Bmax, 3]
+    recs = per_feature_best(fh, totals, meta, params)
+    return reduce_best_record(recs)

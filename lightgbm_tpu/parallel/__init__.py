@@ -1,0 +1,26 @@
+"""Distributed tree learners over JAX device meshes.
+
+TPU-native replacement for the reference's distributed layer: the socket/MPI
+collective stack (src/network/, include/LightGBM/network.h:89-275) collapses
+into XLA collectives (`psum`, `psum_scatter`, `all_gather`) inside
+`jax.shard_map` over a mesh axis; the Bruck/recursive-halving schedules and
+linker plumbing are XLA's job. The three reference parallel learners map to:
+
+  tree_learner=data    -> DataParallelTreeLearner   (rows sharded, histogram
+                          psum_scatter per feature block; the ReduceScatter +
+                          per-rank split search of
+                          src/treelearner/data_parallel_tree_learner.cpp)
+  tree_learner=feature -> FeatureParallelTreeLearner (data replicated, split
+                          scan sharded over features;
+                          src/treelearner/feature_parallel_tree_learner.cpp)
+  tree_learner=voting  -> VotingParallelTreeLearner  (PV-Tree two-phase vote;
+                          src/treelearner/voting_parallel_tree_learner.cpp)
+"""
+from .learners import (DataParallelTreeLearner, FeatureParallelTreeLearner,
+                       VotingParallelTreeLearner, create_parallel_learner)
+from .mesh import data_mesh
+
+__all__ = [
+    "DataParallelTreeLearner", "FeatureParallelTreeLearner",
+    "VotingParallelTreeLearner", "create_parallel_learner", "data_mesh",
+]

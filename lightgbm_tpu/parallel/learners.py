@@ -1,0 +1,321 @@
+"""Distributed tree learners: data-, feature-, and voting-parallel.
+
+All three reuse the leaf-wise control flow of SerialTreeLearner and override
+its device-execution hooks; collectives run inside `jax.shard_map` over the
+``data`` mesh axis, replacing the reference's Network::ReduceScatter /
+Allreduce stack (src/network/network.cpp:71-331).
+
+Data-parallel (src/treelearner/data_parallel_tree_learner.cpp):
+  * rows sharded across devices; a device-resident per-shard leaf-id vector
+    replaces index permutation (the CUDADataPartition design, kept local —
+    partitioning needs NO communication);
+  * per-leaf histograms are built locally then `psum_scatter` distributes
+    aggregated FEATURE blocks (the ReduceScatter with feature-block
+    assignment of :252-299);
+  * each device scans its feature block, then an `all_gather` + argmax picks
+    the global best split (SyncUpGlobalBestSplit, parallel_tree_learner.h:209).
+
+Feature-parallel (feature_parallel_tree_learner.cpp): data replicated, only
+the split scan is sharded over the feature axis, best split all_gathered.
+
+Voting-parallel (voting_parallel_tree_learner.cpp, PV-Tree): each device
+votes its local top-k features from a local scan; the global top-2k by vote
+count are the only histogram columns reduced (`psum` of a [2k, Bmax, 3]
+gather), decoupling comm volume from the feature count.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config import Config
+from ..io.dataset import Dataset
+from ..ops.histogram import build_histogram
+from ..ops.partition import split_decision_bins
+from ..ops.split import (SplitInfo, gather_feature_hist, pad_feature_meta,
+                         per_feature_best, reduce_best_record, scan_meta_of)
+from ..treelearner.serial import SerialTreeLearner, _LeafState
+from ..utils.log import Log
+from .mesh import data_mesh
+
+
+def _ceil_to(n: int, d: int) -> int:
+    return -(-n // d) * d
+
+
+
+def _make_feature_scan_fn(mesh, f_local):
+    """jit(shard_map) best-split scan over feature blocks: each device scans
+    its block, offsets local feature indices, all_gathers the packed records
+    and reduces to the global best (SyncUpGlobalBestSplit)."""
+
+    def scan_block(fh_blk, totals, params, scan_meta_sh):
+        recs = per_feature_best(fh_blk, totals, scan_meta_sh, params)
+        off = (jax.lax.axis_index("data") * f_local).astype(jnp.float32)
+        feat = recs[:, 1]
+        recs = recs.at[:, 1].set(jnp.where(feat >= 0, feat + off, -1.0))
+        all_recs = jax.lax.all_gather(recs, "data", axis=0, tiled=True)
+        return reduce_best_record(all_recs)
+
+    return jax.jit(jax.shard_map(
+        scan_block, mesh=mesh,
+        in_specs=(P("data"), P(), P(), P("data")), out_specs=P(),
+        check_vma=False))
+
+
+class LeafIdPartition:
+    """Partition view backed by a sharded per-row leaf-id vector.
+
+    Exposes the same indices()/count() surface as ops.partition.RowPartition
+    (used by score updates and L1-style leaf refits); index materialization
+    pulls the leaf-id vector to host once per tree.
+    """
+
+    def __init__(self, learner: "DataParallelTreeLearner") -> None:
+        self._learner = learner
+        self.counts = {}
+        self._host_ids: Optional[np.ndarray] = None
+
+    def count(self, leaf: int) -> int:
+        return self.counts[leaf]
+
+    def indices(self, leaf: int) -> np.ndarray:
+        if self._host_ids is None:
+            ids = np.asarray(self._learner.leaf_id)
+            self._host_ids = ids[: self._learner.num_data]
+        return np.nonzero(self._host_ids == leaf)[0].astype(np.int32)
+
+    def invalidate(self) -> None:
+        self._host_ids = None
+
+
+class DataParallelTreeLearner(SerialTreeLearner):
+    def __init__(self, config: Config, dataset: Dataset) -> None:
+        self.mesh = data_mesh(config.num_machines)
+        self.D = int(self.mesh.devices.size)
+        self.n_pad = _ceil_to(dataset.num_data, self.D)
+        super().__init__(config, dataset)
+        F = len(self.meta.real_feature)
+        self.f_pad = _ceil_to(max(F, self.D), self.D)
+        self.f_local = self.f_pad // self.D
+        self.meta_pad = pad_feature_meta(self.meta, self.f_pad)
+        self.scan_meta_sharded = jax.device_put(
+            scan_meta_of(self.meta_pad), NamedSharding(self.mesh, P("data")))
+        self._row_valid = np.zeros(self.n_pad, dtype=bool)
+        self._row_valid[: self.num_data] = True
+        self.leaf_id: Optional[jax.Array] = None
+        self._build_step_fns()
+
+    # -------------------------------------------------------- device layout
+
+    def _device_bins(self, dataset: Dataset) -> jax.Array:
+        """Rows padded to a multiple of the mesh size and sharded on `data`
+        (each device holds its contiguous row block — the pre-partitioned
+        load of DatasetLoader::LoadFromFile(rank, num_machines))."""
+        bins_pad = np.pad(dataset.bins,
+                          ((0, 0), (0, self.n_pad - dataset.num_data)))
+        return jax.device_put(bins_pad,
+                              NamedSharding(self.mesh, P(None, "data")))
+
+    def _build_step_fns(self) -> None:
+        mesh = self.mesh
+        bpad = self.group_bin_padded
+        f_local = self.f_local
+
+        def fh_block(bins_sh, gh_sh, leaf_id_sh, leaf, meta_full):
+            """Local masked histogram -> locally-gathered feature hists ->
+            psum_scatter so each device owns an aggregated feature block."""
+            mask = leaf_id_sh == leaf
+            ghm = jnp.where(mask[:, None], gh_sh, 0.0)
+            hist = build_histogram(bins_sh, ghm, bpad)  # [G, Bpad, 3] local
+            local_tot = hist[0].sum(axis=0)
+            # EFB FixHistogram runs on local totals: the reconstruction is
+            # linear in (hist, totals) so it commutes with the reduction
+            fh = gather_feature_hist(hist, meta_full, local_tot)
+            return jax.lax.psum_scatter(fh, "data", scatter_dimension=0,
+                                        tiled=True)
+
+        self._fh_block_fn = jax.jit(jax.shard_map(
+            fh_block, mesh=mesh,
+            in_specs=(P(None, "data"), P("data"), P("data"), P(), P()),
+            out_specs=P("data")))
+
+        self._scan_fn = _make_feature_scan_fn(mesh, f_local)
+
+        def totals_fn(gh_sh, leaf_id_sh):
+            mask = leaf_id_sh == 0
+            return jax.lax.psum(
+                jnp.where(mask[:, None], gh_sh, 0.0).sum(axis=0), "data")
+
+        self._totals_fn = jax.jit(jax.shard_map(
+            totals_fn, mesh=mesh,
+            in_specs=(P("data"), P("data")), out_specs=P()))
+
+        def partition_fn(bins_sh, leaf_id_sh, decision, gi, leaf, new_leaf):
+            gb = jnp.take(bins_sh, gi, axis=0)
+            go_left = split_decision_bins(gb, decision)
+            on_leaf = leaf_id_sh == leaf
+            new_ids = jnp.where(on_leaf & go_left, leaf,
+                                jnp.where(on_leaf, new_leaf, leaf_id_sh))
+            left = jax.lax.psum((on_leaf & go_left).sum(), "data")
+            return new_ids, left
+
+        self._partition_fn = jax.jit(jax.shard_map(
+            partition_fn, mesh=mesh,
+            in_specs=(P(None, "data"), P("data"), P(), P(), P(), P()),
+            out_specs=(P("data"), P())))
+
+    # ------------------------------------------------------------------ hooks
+
+    def _begin_tree(self, gh_ext: jax.Array,
+                    bag_indices: Optional[np.ndarray]) -> None:
+        n, npad = self.num_data, self.n_pad
+        gh = jnp.concatenate(
+            [gh_ext[:n], jnp.zeros((npad - n, gh_ext.shape[1]), gh_ext.dtype)])
+        self._gh_sh = jax.device_put(gh, NamedSharding(self.mesh, P("data")))
+        in_bag = self._row_valid
+        if bag_indices is not None:
+            in_bag = np.zeros(npad, dtype=bool)
+            in_bag[np.asarray(bag_indices, dtype=np.int64)] = True
+            in_bag &= self._row_valid
+        ids = np.where(in_bag, 0, -1).astype(np.int32)
+        self.leaf_id = jax.device_put(ids, NamedSharding(self.mesh, P("data")))
+        self.partition = LeafIdPartition(self)
+        self.partition.counts[0] = int(in_bag.sum())
+
+    def _leaf_hist(self, leaf: int) -> jax.Array:
+        return self._fh_block_fn(self.bins_dev, self._gh_sh, self.leaf_id,
+                                 jnp.int32(leaf), self.meta_pad)
+
+    def _root_totals(self, root_hist) -> Tuple[float, float, float]:
+        tot = np.asarray(self._totals_fn(self._gh_sh, self.leaf_id))
+        return (float(tot[0]), float(tot[1]), float(tot[2]))
+
+    def _search_split(self, state: _LeafState) -> SplitInfo:
+        rec = self._scan_fn(state.hist,
+                            jnp.asarray(state.totals, dtype=jnp.float32),
+                            self.params_dev, self.scan_meta_sharded)
+        return SplitInfo.from_packed(np.asarray(rec))
+
+    def _partition_split(self, leaf: int, new_leaf: int, gi: int,
+                         decision: jax.Array) -> Tuple[int, int]:
+        new_ids, left_dev = self._partition_fn(
+            self.bins_dev, self.leaf_id, decision, jnp.int32(gi),
+            jnp.int32(leaf), jnp.int32(new_leaf))
+        self.leaf_id = new_ids
+        left = int(left_dev)
+        parent = self.partition.counts[leaf]
+        self.partition.counts[leaf] = left
+        self.partition.counts[new_leaf] = parent - left
+        self.partition.invalidate()
+        return left, parent - left
+
+
+class FeatureParallelTreeLearner(SerialTreeLearner):
+    """Full data on every device; only the split scan is feature-sharded."""
+
+    def __init__(self, config: Config, dataset: Dataset) -> None:
+        self.mesh = data_mesh(config.num_machines)
+        self.D = int(self.mesh.devices.size)
+        super().__init__(config, dataset)
+        F = len(self.meta.real_feature)
+        self.f_pad = _ceil_to(max(F, self.D), self.D)
+        self.f_local = self.f_pad // self.D
+        self.meta_pad = pad_feature_meta(self.meta, self.f_pad)
+        self.scan_meta_sharded = jax.device_put(
+            scan_meta_of(self.meta_pad), NamedSharding(self.mesh, P("data")))
+        self._scan_fn = _make_feature_scan_fn(self.mesh, self.f_local)
+        self._gather_fn = jax.jit(gather_feature_hist)
+
+    def _search_split(self, state: _LeafState) -> SplitInfo:
+        totals = jnp.asarray(state.totals, dtype=jnp.float32)
+        fh = self._gather_fn(state.hist, self.meta_pad, totals)
+        rec = self._scan_fn(fh, totals, self.params_dev, self.scan_meta_sharded)
+        return SplitInfo.from_packed(np.asarray(rec))
+
+
+class VotingParallelTreeLearner(DataParallelTreeLearner):
+    """PV-Tree: two-phase voting (local top-k -> global top-2k -> reduce only
+    the elected columns)."""
+
+    def __init__(self, config: Config, dataset: Dataset) -> None:
+        super().__init__(config, dataset)
+        F = len(self.meta.real_feature)
+        self.k_local = max(1, min(config.top_k, F))
+        self.k_global = max(1, min(2 * config.top_k, F))
+        # voting replaces the DP psum_scatter hist + feature-block scan with
+        # its own local-hist/vote pipeline (only totals/partition are reused)
+        self._fh_block_fn = None
+        self._scan_fn = None
+        self.scan_meta_full = scan_meta_of(self.meta_pad)
+        self._build_voting_fns()
+
+    def _build_voting_fns(self) -> None:
+        mesh = self.mesh
+        bpad = self.group_bin_padded
+        k_local, k_global = self.k_local, self.k_global
+
+        def local_hist(bins_sh, gh_sh, leaf_id_sh, leaf):
+            mask = leaf_id_sh == leaf
+            ghm = jnp.where(mask[:, None], gh_sh, 0.0)
+            hist = build_histogram(bins_sh, ghm, bpad)
+            return hist[None]  # stacked [1, G, Bpad, 3] per device
+
+        self._local_hist_fn = jax.jit(jax.shard_map(
+            local_hist, mesh=mesh,
+            in_specs=(P(None, "data"), P("data"), P("data"), P()),
+            out_specs=P("data")))
+
+        def vote_scan(local_hist_blk, totals, params, meta_full, scan_meta_full):
+            lh = local_hist_blk[0]  # this device's [G, Bpad, 3]
+            local_tot = lh[0].sum(axis=0)
+            fh_local = gather_feature_hist(lh, meta_full, local_tot)
+            local_recs = per_feature_best(fh_local, local_tot,
+                                          scan_meta_full, params)
+            # phase 1: local proposal of top-k features by local gain
+            _, topk_idx = jax.lax.top_k(local_recs[:, 0], k_local)
+            votes = jax.lax.all_gather(topk_idx, "data", tiled=True)
+            counts = jnp.zeros((fh_local.shape[0],), jnp.int32).at[votes].add(1)
+            # phase 2: global top-2k by vote count (GlobalVoting,
+            # parallel_tree_learner.h:153); replicated + deterministic
+            _, selected = jax.lax.top_k(counts, k_global)
+            sel_fh = jax.lax.psum(fh_local[selected], "data")  # [K, Bmax, 3]
+            sel_meta = jax.tree_util.tree_map(
+                lambda a: a[selected], scan_meta_full)
+            recs = per_feature_best(sel_fh, totals, sel_meta, params)
+            valid = recs[:, 1] >= 0
+            recs = recs.at[:, 1].set(
+                jnp.where(valid, selected.astype(jnp.float32), -1.0))
+            return reduce_best_record(recs)
+
+        self._vote_scan_fn = jax.jit(jax.shard_map(
+            vote_scan, mesh=mesh,
+            in_specs=(P("data"), P(), P(), P(), P()), out_specs=P(),
+            check_vma=False))
+
+    def _leaf_hist(self, leaf: int) -> jax.Array:
+        return self._local_hist_fn(self.bins_dev, self._gh_sh, self.leaf_id,
+                                   jnp.int32(leaf))
+
+    def _search_split(self, state: _LeafState) -> SplitInfo:
+        rec = self._vote_scan_fn(state.hist,
+                                 jnp.asarray(state.totals, dtype=jnp.float32),
+                                 self.params_dev, self.meta_pad,
+                                 self.scan_meta_full)
+        return SplitInfo.from_packed(np.asarray(rec))
+
+
+def create_parallel_learner(learner_type: str, config: Config,
+                            dataset: Dataset):
+    if learner_type == "data":
+        return DataParallelTreeLearner(config, dataset)
+    if learner_type == "feature":
+        return FeatureParallelTreeLearner(config, dataset)
+    if learner_type == "voting":
+        return VotingParallelTreeLearner(config, dataset)
+    Log.fatal("Unknown parallel tree learner type: %s", learner_type)

@@ -1,0 +1,26 @@
+"""Device-mesh construction for distributed training.
+
+The reference sizes its world from `num_machines` + a machine list
+(src/network/linkers_socket.cpp); here the world is the JAX device set —
+all local TPU cores by default, every process's devices under
+`jax.distributed.initialize` for multi-host. `num_machines` (kept for config
+compatibility) caps the mesh when > 1.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def data_mesh(num_machines: int = 0) -> jax.sharding.Mesh:
+    """1-D mesh over the row-sharding axis ``data``.
+
+    num_machines <= 1 means "use every visible device" (the reference's
+    num_machines=1 is non-distributed; on TPU a single host already exposes
+    the full slice, so defaulting to all cores is the native analog).
+    """
+    devices = jax.devices()
+    n = len(devices)
+    if num_machines and num_machines > 1:
+        n = min(num_machines, n)
+    return jax.sharding.Mesh(np.array(devices[:n]), ("data",))

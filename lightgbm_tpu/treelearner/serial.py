@@ -50,7 +50,7 @@ class SerialTreeLearner:
         self.dataset = dataset
         self.num_data = dataset.num_data
         # device-resident bin matrix (the CUDARowData analog)
-        self.bins_dev = jnp.asarray(dataset.bins)
+        self.bins_dev = self._device_bins(dataset)
         self.group_bin_padded = int(max(dataset.group_bin_counts().max(), 2))
         self.meta: FeatureMeta = make_feature_meta(dataset, self.group_bin_padded)
         self.params_dev = jnp.asarray([
@@ -69,17 +69,12 @@ class SerialTreeLearner:
         cfg = self.config
         num_leaves = cfg.num_leaves
         tree = Tree(num_leaves)
-        partition = RowPartition(self.num_data)
-        if bag_indices is not None:
-            partition.set_used_indices(bag_indices)
-        self.partition = partition
+        self._begin_tree(gh_ext, bag_indices)
 
         frontier: Dict[int, _LeafState] = {}
         with global_timer.scope("hist_root"):
-            root_hist = build_histogram_rows(
-                self.bins_dev, gh_ext, partition.indices(0), self.group_bin_padded)
-        root_totals_dev = root_hist[0].sum(axis=0)
-        root_totals = tuple(float(x) for x in np.asarray(root_totals_dev))
+            root_hist = self._leaf_hist(0)
+        root_totals = self._root_totals(root_hist)
         frontier[0] = _LeafState(root_hist, root_totals, None, depth=0)
         self._find_split(frontier, 0)
 
@@ -92,7 +87,7 @@ class SerialTreeLearner:
             if best_leaf is None:
                 Log.debug("No further splits with positive gain, best gain: -inf")
                 break
-            self._apply_split(tree, frontier, best_leaf, best, gh_ext)
+            self._apply_split(tree, frontier, best_leaf, best)
             if tree.num_leaves >= num_leaves:
                 break
 
@@ -101,6 +96,40 @@ class SerialTreeLearner:
             tree.as_constant_tree(0.0)
         self._last_frontier = frontier
         return tree
+
+    # ------------------------------------------------ device-execution hooks
+    # The parallel learners (parallel/learners.py) subclass and override
+    # these hooks; the leaf-wise control flow above is shared.
+
+    def _device_bins(self, dataset: Dataset) -> jax.Array:
+        return jnp.asarray(dataset.bins)
+
+    def _begin_tree(self, gh_ext: jax.Array,
+                    bag_indices: Optional[np.ndarray]) -> None:
+        self._gh = gh_ext
+        partition = RowPartition(self.num_data)
+        if bag_indices is not None:
+            partition.set_used_indices(bag_indices)
+        self.partition = partition
+
+    def _leaf_hist(self, leaf: int) -> jax.Array:
+        return build_histogram_rows(
+            self.bins_dev, self._gh, self.partition.indices(leaf),
+            self.group_bin_padded)
+
+    def _root_totals(self, root_hist: jax.Array) -> Tuple[float, float, float]:
+        # any group's bins partition all rows, so group 0's bin-sum = totals
+        return tuple(float(x) for x in np.asarray(root_hist[0].sum(axis=0)))
+
+    def _search_split(self, state: "_LeafState") -> SplitInfo:
+        rec = find_best_split(
+            state.hist, jnp.asarray(state.totals, dtype=jnp.float32),
+            self.meta, self.params_dev)
+        return SplitInfo.from_packed(np.asarray(rec))
+
+    def _partition_split(self, leaf: int, new_leaf: int, gi: int,
+                         decision: jax.Array) -> Tuple[int, int]:
+        return self.partition.split(leaf, new_leaf, self.bins_dev[gi], decision)
 
     # --------------------------------------------------------------- internal
 
@@ -116,13 +145,10 @@ class SerialTreeLearner:
             state.split = SplitInfo()
             return
         with global_timer.scope("find_best_split"):
-            rec = find_best_split(
-                state.hist, jnp.asarray(state.totals, dtype=jnp.float32),
-                self.meta, self.params_dev)
-            state.split = SplitInfo.from_packed(np.asarray(rec))
+            state.split = self._search_split(state)
 
     def _apply_split(self, tree: Tree, frontier: Dict[int, _LeafState],
-                     leaf: int, split: SplitInfo, gh_ext: jax.Array) -> None:
+                     leaf: int, split: SplitInfo) -> None:
         ds = self.dataset
         meta = self.meta
         dense_f = split.feature
@@ -160,8 +186,8 @@ class SerialTreeLearner:
             1.0 if fg.is_multi else 0.0,
         ], dtype=jnp.float32)
         with global_timer.scope("partition"):
-            left_cnt, right_cnt = self.partition.split(
-                leaf, new_leaf, self.bins_dev[gi], decision)
+            left_cnt, right_cnt = self._partition_split(
+                leaf, new_leaf, gi, decision)
         if left_cnt != split.left_count or right_cnt != split.right_count:
             Log.debug("Partition count mismatch at leaf %d: %d/%d vs %d/%d",
                       leaf, left_cnt, right_cnt, split.left_count, split.right_count)
@@ -173,13 +199,9 @@ class SerialTreeLearner:
         with global_timer.scope("hist_children"):
             if left_cnt <= right_cnt:
                 small, big = leaf, new_leaf
-                small_tot, big_tot = left_totals, right_totals
             else:
                 small, big = new_leaf, leaf
-                small_tot, big_tot = right_totals, left_totals
-            small_hist = build_histogram_rows(
-                self.bins_dev, gh_ext, self.partition.indices(small),
-                self.group_bin_padded)
+            small_hist = self._leaf_hist(small)
             big_hist = subtract_histogram(parent_hist, small_hist)
         depth = state.depth + 1
         frontier[leaf] = _LeafState(
