@@ -21,7 +21,7 @@ from .config import Config, key_alias_transform, parse_objective_alias
 from .io.dataset import Dataset as _CoreDataset
 from .io.parser import (load_positions, load_query_boundaries, load_weights,
                         parse_file)
-from .models.gbdt import GBDT
+from .models.gbdt import GBDT, create_boosting
 from .models.serialize import GBDTModel
 from .objectives import create_objective
 from .utils.log import Log, LightGBMError
@@ -253,8 +253,8 @@ class Booster:
             train_set.construct()
             self.config = Config(self.params)
             objective = create_objective(self.config.objective, self.config)
-            self._gbdt = GBDT(self.config, train_set._handle, objective,
-                              train_raw=train_set._raw)
+            self._gbdt = create_boosting(self.config, train_set._handle,
+                                         objective, train_raw=train_set._raw)
             self.train_set = train_set
             self._model: Optional[GBDTModel] = None
         elif model_file is not None or model_str is not None:
@@ -267,6 +267,7 @@ class Booster:
             self._gbdt.num_class = model.num_class
             self._gbdt.num_tree_per_iteration = model.num_tree_per_iteration
             self._gbdt.objective = _objective_from_string(model.objective_str, self.config)
+            self._gbdt.average_output = model.average_output
             self.train_set = None
             self.pandas_categorical = None
         else:
@@ -296,6 +297,9 @@ class Booster:
         return self._gbdt.train_one_iter()
 
     def __pred_for_fobj(self):
+        # DART must drop trees BEFORE custom gradients read the score
+        # (GetTrainingScore triggers DroppingTrees, dart.hpp:78-88)
+        self._gbdt.prepare_training_score()
         score = np.asarray(self._gbdt.score)
         return score.ravel() if score.shape[0] == 1 else score.T
 

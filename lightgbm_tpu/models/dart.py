@@ -1,0 +1,118 @@
+"""DART boosting: Dropouts meet Multiple Additive Regression Trees.
+
+Counterpart of src/boosting/dart.hpp:23-211. Per iteration a random subset of
+existing trees is dropped (uniform or weighted by tree weight, capped by
+max_drop, skipped entirely with probability skip_drop); the new tree is fit
+to gradients of the dropped score; then dropped + new trees are renormalized
+(standard mode: new weight lr/(k+1), dropped shrink by k/(k+1);
+xgboost_dart_mode: lr/(lr+k) and k/(lr+k)).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .gbdt import GBDT, K_EPSILON
+
+
+class DART(GBDT):
+    def __init__(self, config, train_set, objective, train_raw=None) -> None:
+        super().__init__(config, train_set, objective, train_raw)
+        self._drop_rng = np.random.RandomState(config.drop_seed)
+        self.tree_weight = []  # per-iteration weights (non-uniform drop)
+        self.sum_weight = 0.0
+        self.drop_index = []
+        self._dropped_this_iter = False
+
+    def prepare_training_score(self) -> None:
+        """Drop once per iteration, before any gradient reads the score —
+        custom objectives hit this via Booster.update (dart.hpp:78-88)."""
+        if not self._dropped_this_iter:
+            self._dropping_trees()
+            self._dropped_this_iter = True
+
+    def train_one_iter(self, gradients: Optional[np.ndarray] = None,
+                       hessians: Optional[np.ndarray] = None) -> bool:
+        self.prepare_training_score()
+        self._dropped_this_iter = False  # re-arm for the next iteration
+        stop = super().train_one_iter(gradients, hessians)
+        if stop:
+            return True
+        self._normalize()
+        if not self.config.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False
+
+    # ------------------------------------------------------------- internals
+
+    def _dropping_trees(self) -> None:
+        cfg = self.config
+        C = self.num_tree_per_iteration
+        self.drop_index = []
+        is_skip = self._drop_rng.rand() < cfg.skip_drop
+        if not is_skip and self.iter_ > 0:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop:
+                if self.sum_weight > 0:
+                    inv_avg = len(self.tree_weight) / self.sum_weight
+                    if cfg.max_drop > 0:
+                        drop_rate = min(
+                            drop_rate, cfg.max_drop * inv_avg / self.sum_weight)
+                    for i in range(self.iter_):
+                        if (self._drop_rng.rand()
+                                < drop_rate * self.tree_weight[i] * inv_avg):
+                            self.drop_index.append(i)
+                            if 0 < cfg.max_drop <= len(self.drop_index):
+                                break
+            else:
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / float(self.iter_))
+                for i in range(self.iter_):
+                    if self._drop_rng.rand() < drop_rate:
+                        self.drop_index.append(i)
+                        if 0 < cfg.max_drop <= len(self.drop_index):
+                            break
+        # remove dropped trees from the TRAIN score only (valid scores are
+        # fixed up during Normalize, matching dart.hpp:131-137)
+        for i in self.drop_index:
+            for c in range(C):
+                tree = self.models[i * C + c]
+                tree.shrink(-1.0)
+                self._add_tree_to_train_score(tree, c)
+        k = float(len(self.drop_index))
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + k)
+        else:
+            self.shrinkage_rate = (
+                cfg.learning_rate if not self.drop_index
+                else cfg.learning_rate / (cfg.learning_rate + k))
+
+    def _normalize(self) -> None:
+        cfg = self.config
+        C = self.num_tree_per_iteration
+        k = float(len(self.drop_index))
+        for i in self.drop_index:
+            for c in range(C):
+                tree = self.models[i * C + c]
+                if not cfg.xgboost_dart_mode:
+                    # tree weight ends at old_weight * k/(k+1) (dart.hpp:149-158)
+                    tree.shrink(1.0 / (k + 1.0))
+                    self._update_valid_scores(tree, c)
+                    tree.shrink(-k)
+                    self._add_tree_to_train_score(tree, c)
+                else:
+                    tree.shrink(self.shrinkage_rate)
+                    self._update_valid_scores(tree, c)
+                    tree.shrink(-k / cfg.learning_rate)
+                    self._add_tree_to_train_score(tree, c)
+            if not cfg.uniform_drop:
+                if not cfg.xgboost_dart_mode:
+                    self.sum_weight -= self.tree_weight[i] * (1.0 / (k + 1.0))
+                    self.tree_weight[i] *= k / (k + 1.0)
+                else:
+                    self.sum_weight -= self.tree_weight[i] * (
+                        1.0 / (k + cfg.learning_rate))
+                    self.tree_weight[i] *= k / (k + cfg.learning_rate)
+        self._packed_cache = None

@@ -19,10 +19,13 @@ from ..config import Config
 from ..io.dataset import Dataset
 from ..metrics import create_metric
 from ..objectives import ObjectiveFunction
+from ..ops.partition import pad_indices
 from ..ops.predict import pack_ensemble, predict_raw
+from ..ops.score import add_tree_to_score
 from ..treelearner import create_tree_learner
 from ..utils.log import Log
 from ..utils.timer import global_timer
+from .sample_strategy import create_sample_strategy
 from .serialize import GBDTModel
 from .tree import Tree
 
@@ -57,6 +60,7 @@ class GBDT:
         self.iter_ = 0
         self.models: List[Tree] = []
         self.best_iteration = 0
+        self.average_output = False  # RF sets True (rf.hpp)
         self.shrinkage_rate = config.learning_rate
         self.num_class = max(config.num_class, 1)
         if objective is not None:
@@ -81,6 +85,9 @@ class GBDT:
                         for c in range(self.num_tree_per_iteration)]
             self.tree_learner = create_tree_learner(
                 config.tree_learner, config.device_type, config, train_set)
+            self.sample_strategy = create_sample_strategy(
+                config, n, train_set.metadata, self.num_tree_per_iteration)
+            self._cur_bag: Optional[np.ndarray] = None
             self.train_metrics = [m for m in
                                   (create_metric(name, config) for name in config.metric)
                                   if m is not None]
@@ -121,12 +128,14 @@ class GBDT:
     # --------------------------------------------------------------- boosting
 
     def _compute_gh(self, score):
-        """C==1: score [N] -> gh_ext [N+1, 3]. C>1: score [C, N] ->
-        (grad [C, N], hess [C, N]) — the whole-iteration gradient pass."""
-        if self.num_tree_per_iteration > 1:
-            return self.objective.get_gradients(score)
-        grad, hess = self.objective.get_gradients(score)
-        return _pack_gh(grad, hess)
+        """score [N] (C==1) or [C, N] -> (grad, hess) matching shapes — the
+        whole-iteration gradient pass (kept unpacked so the sample strategy
+        can rescale GOSS's small-gradient rows before packing)."""
+        return self.objective.get_gradients(score)
+
+    def prepare_training_score(self) -> None:
+        """Hook run before custom gradients read the training score
+        (GetTrainingScore, boosting.h); DART drops trees here."""
 
     def boost_from_average(self, class_id: int) -> float:
         """gbdt.cpp:327-350."""
@@ -154,21 +163,27 @@ class GBDT:
             for c in range(C):
                 init_scores[c] = self.boost_from_average(c)
         should_continue = False
-        all_grads = all_hesses = None
-        if not custom and C > 1:
-            with global_timer.scope("boosting"):
-                all_grads, all_hesses = self._grad_fn(self.score)
+        with global_timer.scope("boosting"):
+            if custom:
+                grads = jnp.asarray(gradients, dtype=jnp.float32).reshape(
+                    C, self.num_data)
+                hesses = jnp.asarray(hessians, dtype=jnp.float32).reshape(
+                    C, self.num_data)
+                if C == 1:
+                    grads, hesses = grads[0], hesses[0]
+            else:
+                grads, hesses = self._grad_fn(
+                    self.score if C > 1 else self.score[0])
+        with global_timer.scope("bagging"):
+            bag, grads, hesses = self.sample_strategy.bagging(
+                self.iter_, grads, hesses)
+            self._refresh_bag_cache(bag)
         for c in range(C):
             with global_timer.scope("boosting"):
-                if custom:
-                    g = jnp.asarray(gradients.reshape(C, self.num_data)[c])
-                    h = jnp.asarray(hessians.reshape(C, self.num_data)[c])
-                    gh_ext = _pack_gh(g, h)
-                elif C > 1:
-                    gh_ext = _pack_gh(all_grads[c], all_hesses[c])
+                if C > 1:
+                    gh_ext = _pack_gh(grads[c], hesses[c])
                 else:
-                    gh_ext = self._grad_fn(self.score[0])
-            bag = self._bag_indices(c)
+                    gh_ext = _pack_gh(grads, hesses)
             new_tree = Tree(2)
             if self.class_need_train[c] and self.train_set.num_features > 0:
                 with global_timer.scope("tree_train"):
@@ -206,17 +221,71 @@ class GBDT:
         self.iter_ += 1
         return False
 
-    def _bag_indices(self, class_id: int) -> Optional[np.ndarray]:
-        return None  # bagging/GOSS strategies plug in here
-
     # ------------------------------------------------------------------ score
+
+    def _refresh_bag_cache(self, bag: Optional[np.ndarray]) -> None:
+        """The bag is reused across bagging_freq iterations, so the padded
+        out-of-bag index array is computed once per bag change."""
+        if bag is self._cur_bag and getattr(self, "_oob_padded_ready", False):
+            return
+        self._cur_bag = bag
+        self._oob_padded_ready = True
+        if bag is not None and len(bag) < self.num_data:
+            oob = np.setdiff1d(np.arange(self.num_data, dtype=np.int32), bag)
+            self._oob_padded = jnp.asarray(pad_indices(oob, self.num_data))
+        else:
+            self._oob_padded = None
+
+    @property
+    def _depth_bound(self) -> int:
+        return (self.config.max_depth if self.config.max_depth > 0
+                else self.config.num_leaves - 1)
+
+    def _all_rows_padded(self) -> jax.Array:
+        if getattr(self, "_all_rows_cache", None) is None:
+            self._all_rows_cache = jnp.asarray(pad_indices(
+                np.arange(self.num_data, dtype=np.int32), self.num_data))
+        return self._all_rows_cache
+
+    def _add_tree_to_train_score(self, tree: Tree, class_id: int) -> None:
+        """Add an arbitrary (e.g. previously trained) tree's outputs to the
+        train score of every row via bin-space traversal — the train-time
+        ScoreUpdater::AddScore(tree) path DART/RF renormalization needs."""
+        score = add_tree_to_score(
+            tree, self.train_set, self.tree_learner.bins_dev,
+            self.score[class_id], self._all_rows_padded(), self.num_data,
+            self._depth_bound)
+        self.score = self.score.at[class_id].set(score)
+
+    def _multiply_score(self, class_id: int, val: float) -> None:
+        """ScoreUpdater::MultiplyScore on train + valid (RF averaging)."""
+        self.score = self.score.at[class_id].multiply(val)
+        for vd in self.valid_sets:
+            vd.score = vd.score.at[class_id].multiply(val)
 
     def _update_train_score(self, tree: Tree, class_id: int) -> None:
         part = self.tree_learner.partition
         score = self.score[class_id]
-        for leaf in range(tree.num_leaves):
-            idx = part.indices(leaf)
-            score = score.at[idx].add(tree.leaf_value[leaf], mode="drop")
+        ids_fn = getattr(part, "leaf_ids_dev", None)
+        if ids_fn is not None:
+            # vectorized path: one gather over the device leaf-id vector
+            # (bagged-out rows carry -1 and contribute nothing)
+            ids = ids_fn()
+            lv = jnp.asarray(tree.leaf_value[: tree.num_leaves],
+                             dtype=jnp.float32)
+            score = score + jnp.where(
+                ids >= 0, lv[jnp.clip(ids, 0, tree.num_leaves - 1)], 0.0)
+        else:
+            for leaf in range(tree.num_leaves):
+                idx = part.indices(leaf)
+                score = score.at[idx].add(tree.leaf_value[leaf], mode="drop")
+        bag = self._cur_bag
+        if bag is not None and self._oob_padded is not None:
+            # out-of-bag rows: bin-space tree traversal (the train-time
+            # AddPredictionToScore path, gbdt.cpp out_of_bag update)
+            score = add_tree_to_score(
+                tree, self.train_set, self.tree_learner.bins_dev, score,
+                self._oob_padded, self.num_data, self._depth_bound)
         self.score = self.score.at[class_id].set(score)
 
     def _update_valid_scores(self, tree: Tree, class_id: int) -> None:
@@ -265,6 +334,8 @@ class GBDT:
         packed = self._packed(num_iteration)
         out = predict_raw(packed, jnp.asarray(X, dtype=jnp.float32),
                           self.num_tree_per_iteration)
+        if self.average_output and packed.num_trees > 0:
+            out = out / (packed.num_trees // self.num_tree_per_iteration)
         if not raw_score and self.objective is not None:
             out = self.objective.convert_output(out)
         res = np.asarray(out)
@@ -308,5 +379,25 @@ class GBDT:
         model.monotone_constraints = list(ds.monotone_constraints) if ds is not None else []
         model.trees = self.models
         model.best_iteration = self.best_iteration
+        model.average_output = self.average_output
         model.parameters_str = self.config.to_string()
         return model
+
+
+def create_boosting(config: Config, train_set: Optional[Dataset],
+                    objective: Optional[ObjectiveFunction],
+                    train_raw: Optional[np.ndarray] = None) -> GBDT:
+    """Boosting factory (boosting.cpp:41-101): gbdt / dart / rf; the legacy
+    boosting=goss spelling trains a GBDT with the GOSS sample strategy."""
+    b = config.boosting
+    if b == "dart":
+        from .dart import DART
+
+        return DART(config, train_set, objective, train_raw)
+    if b in ("rf", "random_forest"):
+        from .rf import RF
+
+        return RF(config, train_set, objective, train_raw)
+    if b in ("gbdt", "gbrt", "gbm", "goss"):
+        return GBDT(config, train_set, objective, train_raw)
+    Log.fatal("Unknown boosting type %s", b)

@@ -1,0 +1,145 @@
+"""Row sampling strategies: bagging and GOSS.
+
+Counterpart of src/boosting/sample_strategy.{h,cpp} (factory), bagging.hpp
+(BaggingSampleStrategy) and goss.hpp (GOSSStrategy). The strategy runs on
+host once per iteration over the gradient arrays (GOSS needs |g·h| scores)
+and hands the tree learner a bag index set; gradient rescaling for GOSS's
+small-gradient sample happens on device.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import Log
+
+
+class SampleStrategy:
+    """Base: no sampling (full data every iteration)."""
+
+    is_use_subset = False
+
+    def __init__(self, config: Config, num_data: int, metadata,
+                 num_tree_per_iteration: int) -> None:
+        self.config = config
+        self.num_data = num_data
+        self.metadata = metadata
+        self.num_tree_per_iteration = num_tree_per_iteration
+
+    def bagging(self, iteration: int, grad, hess
+                ) -> Tuple[Optional[np.ndarray], object, object]:
+        """Returns (bag_indices or None for full data, grad, hess) — the
+        gradients are passed through so GOSS can rescale them."""
+        return None, grad, hess
+
+
+class BaggingSampleStrategy(SampleStrategy):
+    """bagging_fraction / bagging_freq (+ pos/neg fractions for binary)
+    — bagging.hpp:30-296. The bag is resampled every `bagging_freq`
+    iterations and reused in between."""
+
+    def __init__(self, config: Config, num_data: int, metadata,
+                 num_tree_per_iteration: int) -> None:
+        super().__init__(config, num_data, metadata, num_tree_per_iteration)
+        self.balanced = (config.pos_bagging_fraction < 1.0
+                         or config.neg_bagging_fraction < 1.0)
+        self.need = config.bagging_freq > 0 and (
+            config.bagging_fraction < 1.0 or self.balanced)
+        if self.balanced and config.objective not in ("binary",):
+            Log.warning("Only can use pos/neg bagging with binary objective")
+            self.balanced = False
+            self.need = config.bagging_freq > 0 and config.bagging_fraction < 1.0
+        self._bag: Optional[np.ndarray] = None
+
+    def bagging(self, iteration: int, grad, hess):
+        if not self.need:
+            return None, grad, hess
+        freq = self.config.bagging_freq
+        if self._bag is None or iteration % freq == 0:
+            rng = np.random.RandomState(self.config.bagging_seed + iteration)
+            if self.balanced:
+                label = np.asarray(self.metadata.label)
+                pos = label > 0
+                keep = np.where(
+                    pos, rng.rand(self.num_data) < self.config.pos_bagging_fraction,
+                    rng.rand(self.num_data) < self.config.neg_bagging_fraction)
+                self._bag = np.nonzero(keep)[0].astype(np.int32)
+            else:
+                cnt = int(round(self.config.bagging_fraction * self.num_data))
+                cnt = max(min(cnt, self.num_data), 1)
+                self._bag = np.sort(rng.choice(
+                    self.num_data, cnt, replace=False)).astype(np.int32)
+        return self._bag, grad, hess
+
+
+class GOSSStrategy(SampleStrategy):
+    """Gradient-based One-Side Sampling — goss.hpp:30-172.
+
+    Keeps the top `top_rate` fraction of rows by sum_c |g_c·h_c|, samples
+    `other_rate` of the rest, and scales the sampled small-gradient rows'
+    grad/hess by (1-top_rate)/other_rate. Inactive during the warm-up
+    (iteration < 1/learning_rate, goss.hpp) like the reference.
+    """
+
+    def __init__(self, config: Config, num_data: int, metadata,
+                 num_tree_per_iteration: int) -> None:
+        super().__init__(config, num_data, metadata, num_tree_per_iteration)
+        if config.top_rate + config.other_rate > 1.0:
+            Log.fatal("The sum of top_rate and other_rate cannot be greater than 1.0")
+        if config.top_rate <= 0.0 or config.other_rate <= 0.0:
+            # goss.hpp CHECK: both subsample fractions must be positive
+            Log.fatal("top_rate and other_rate must be positive in GOSS")
+        if config.bagging_freq > 0 and config.bagging_fraction < 1.0:
+            Log.warning("Cannot use bagging in GOSS")
+
+    def bagging(self, iteration: int, grad, hess):
+        lr = max(self.config.learning_rate, 1e-12)
+        if iteration < int(1.0 / lr):
+            return None, grad, hess
+        import jax.numpy as jnp
+
+        g = np.asarray(grad, dtype=np.float64)
+        h = np.asarray(hess, dtype=np.float64)
+        if g.ndim == 1:
+            score = np.abs(g * h)
+        else:
+            score = np.abs(g * h).sum(axis=0)
+        n = self.num_data
+        top_k = max(int(math.ceil(n * self.config.top_rate)), 1)
+        other_k = int(math.ceil(n * self.config.other_rate))
+        order = np.argsort(-score, kind="stable")
+        top = order[:top_k]
+        rest = order[top_k:]
+        rng = np.random.RandomState(self.config.bagging_seed + iteration)
+        if other_k > 0 and len(rest) > 0:
+            sampled = rng.choice(rest, min(other_k, len(rest)), replace=False)
+        else:
+            sampled = np.empty(0, dtype=np.int64)
+        multiplier = (1.0 - self.config.top_rate) / max(
+            self.config.other_rate, 1e-12)
+        if len(sampled) > 0:
+            sampled_dev = jnp.asarray(np.sort(sampled).astype(np.int32))
+            if g.ndim == 1:
+                grad = grad.at[sampled_dev].mul(multiplier)
+                hess = hess.at[sampled_dev].mul(multiplier)
+            else:
+                grad = grad.at[:, sampled_dev].mul(multiplier)
+                hess = hess.at[:, sampled_dev].mul(multiplier)
+        bag = np.sort(np.concatenate([top, sampled])).astype(np.int32)
+        return bag, grad, hess
+
+
+def create_sample_strategy(config: Config, num_data: int, metadata,
+                           num_tree_per_iteration: int) -> SampleStrategy:
+    """sample_strategy.cpp:27: data_sample_strategy ∈ {bagging, goss}; the
+    legacy boosting=goss spelling is normalized by the config layer."""
+    strategy = config.data_sample_strategy
+    if strategy == "goss" or config.boosting == "goss":
+        return GOSSStrategy(config, num_data, metadata, num_tree_per_iteration)
+    if strategy == "bagging":
+        return BaggingSampleStrategy(config, num_data, metadata,
+                                     num_tree_per_iteration)
+    Log.fatal("Unknown data sample strategy: %s", strategy)

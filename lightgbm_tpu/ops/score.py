@@ -1,0 +1,123 @@
+"""Train-time score updates via bin-space tree traversal.
+
+Counterpart of Tree::AddPredictionToScore over the training dataset
+(include/LightGBM/tree.h:104-132, train-time path using bin thresholds) and
+CUDAScoreUpdater. Bagged training needs it for out-of-bag rows: those rows
+never enter the leaf partition, so their new-tree contribution is computed by
+traversing the tree directly over the binned matrix (exactly the decisions
+the partition made for in-bag rows — threshold_in_bin comparisons, EFB
+group-bin translation, missing direction).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+
+class BinnedTreeArrays(NamedTuple):
+    """Per-internal-node decision fields in bin space + leaf values."""
+
+    group: jax.Array  # [I] int32 feature-group row in the bin matrix
+    threshold: jax.Array  # [I] int32 bin threshold
+    default_left: jax.Array  # [I] bool
+    missing_type: jax.Array  # [I] int32
+    default_bin: jax.Array  # [I] int32 (feature-bin space)
+    nbins: jax.Array  # [I] int32
+    efb_lo: jax.Array  # [I] int32 group-bin range for EFB members
+    efb_hi: jax.Array  # [I] int32
+    is_efb: jax.Array  # [I] bool
+    left_child: jax.Array  # [I] int32
+    right_child: jax.Array  # [I] int32
+    leaf_value: jax.Array  # [L] float32
+
+
+def binned_tree_arrays(tree, dataset) -> BinnedTreeArrays:
+    """Host-side packing of a trained tree's decisions into bin space."""
+    ni = max(tree.num_leaves - 1, 1)
+    gi = np.zeros(ni, dtype=np.int32)
+    th = np.zeros(ni, dtype=np.int32)
+    dl = np.zeros(ni, dtype=bool)
+    mt = np.zeros(ni, dtype=np.int32)
+    db = np.zeros(ni, dtype=np.int32)
+    nb = np.full(ni, 2, dtype=np.int32)
+    lo = np.zeros(ni, dtype=np.int32)
+    hi = np.zeros(ni, dtype=np.int32)
+    ie = np.zeros(ni, dtype=bool)
+    for n in range(tree.num_leaves - 1):
+        f = int(tree.split_feature[n])
+        mapper = dataset.mappers[f]
+        g, mi = dataset.feature_to_group[f]
+        fg = dataset.groups[g]
+        l, h, _ = fg.feature_bin_range(mi)
+        gi[n] = g
+        th[n] = tree.threshold_in_bin[n]
+        dt = int(tree.decision_type[n])
+        dl[n] = bool(dt & 2)
+        mt[n] = (dt >> 2) & 3
+        db[n] = mapper.default_bin
+        nb[n] = mapper.num_bin
+        lo[n], hi[n], ie[n] = l, h, fg.is_multi
+    return BinnedTreeArrays(
+        group=jnp.asarray(gi), threshold=jnp.asarray(th),
+        default_left=jnp.asarray(dl), missing_type=jnp.asarray(mt),
+        default_bin=jnp.asarray(db), nbins=jnp.asarray(nb),
+        efb_lo=jnp.asarray(lo), efb_hi=jnp.asarray(hi), is_efb=jnp.asarray(ie),
+        left_child=jnp.asarray(tree.left_child[:ni].astype(np.int32)),
+        right_child=jnp.asarray(tree.right_child[:ni].astype(np.int32)),
+        leaf_value=jnp.asarray(tree.leaf_value[: tree.num_leaves],
+                               dtype=jnp.float32),
+    )
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def binned_leaf_index(ta: BinnedTreeArrays, bins: jax.Array, row_idx: jax.Array,
+                      num_data: int, max_depth: int) -> jax.Array:
+    """Leaf index [P] for padded row indices (sentinel num_data -> clamped
+    gather; caller drops its scatter)."""
+    rows = jnp.minimum(row_idx, num_data - 1)
+
+    def body(_, node):
+        active = node >= 0
+        nd = jnp.maximum(node, 0)
+        gb = bins[ta.group[nd], rows].astype(jnp.int32)
+        # EFB translation: group bin -> natural feature bin (split_decision_bins)
+        in_range = (gb >= ta.efb_lo[nd]) & (gb < ta.efb_hi[nd])
+        shifted = gb - ta.efb_lo[nd]
+        natural = shifted + (shifted >= ta.default_bin[nd]).astype(jnp.int32)
+        fbin = jnp.where(ta.is_efb[nd],
+                         jnp.where(in_range, natural, ta.default_bin[nd]), gb)
+        mt = ta.missing_type[nd]
+        is_missing = jnp.where(
+            mt == MISSING_NAN, fbin == ta.nbins[nd] - 1,
+            jnp.where(mt == MISSING_ZERO, fbin == ta.default_bin[nd], False))
+        go_left = jnp.where(is_missing, ta.default_left[nd],
+                            fbin <= ta.threshold[nd])
+        nxt = jnp.where(go_left, ta.left_child[nd], ta.right_child[nd])
+        return jnp.where(active, nxt, node)
+
+    node0 = jnp.zeros(row_idx.shape[0], dtype=jnp.int32)
+    node = jax.lax.fori_loop(0, max_depth, body, node0)
+    return ~node
+
+
+def add_tree_to_score(tree, dataset, bins_dev: jax.Array, score: jax.Array,
+                      row_idx: jax.Array, num_data: int,
+                      max_depth: int = 0) -> jax.Array:
+    """score[row] += tree.leaf_value[leaf(row)] for the given padded rows.
+
+    max_depth should be a CONFIG-derived bound, not the tree's actual depth —
+    per-tree depths would recompile the traversal for every distinct value.
+    Extra iterations freeze at the leaf, so over-bounding is free.
+    """
+    if tree.num_leaves <= 1:
+        return score.at[row_idx].add(float(tree.leaf_value[0]), mode="drop")
+    ta = binned_tree_arrays(tree, dataset)
+    bound = max_depth if max_depth > 0 else int(tree.max_depth)
+    leaf = binned_leaf_index(ta, bins_dev, row_idx, num_data, bound)
+    return score.at[row_idx].add(ta.leaf_value[leaf], mode="drop")

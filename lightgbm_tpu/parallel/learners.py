@@ -84,6 +84,10 @@ class LeafIdPartition:
     def count(self, leaf: int) -> int:
         return self.counts[leaf]
 
+    def leaf_ids_dev(self) -> jax.Array:
+        """Vectorized score-update fast path (see GBDT._update_train_score)."""
+        return self._learner.leaf_id[: self._learner.num_data]
+
     def indices(self, leaf: int) -> np.ndarray:
         if self._host_ids is None:
             ids = np.asarray(self._learner.leaf_id)

@@ -227,6 +227,22 @@ def create_tree_learner(learner_type: str, device_type: str, config: Config,
     """Factory (tree_learner.cpp:17-57). Distributed learners (feature/data/
     voting) are built on the parallel backend in parallel/."""
     if learner_type in ("serial",):
+        from .device import DeviceTreeLearner, pool_bytes, POOL_BYTE_LIMIT
+
+        # The on-device whole-tree learner trades O(leaf) index gathers for
+        # O(N) static-shape masked histograms — near-free on the MXU, slow on
+        # the CPU backend — so it is selected on accelerators only (and when
+        # its histogram pool fits); device_type=cpu forces the host-driven
+        # learner regardless of the attached backend.
+        try:
+            on_accelerator = jax.default_backend() not in ("cpu",)
+        except RuntimeError:
+            on_accelerator = False
+        if (device_type != "cpu" and on_accelerator and pool_bytes(
+                config.num_leaves, dataset.num_groups,
+                int(max(dataset.group_bin_counts().max(), 2))
+                ) <= POOL_BYTE_LIMIT):
+            return DeviceTreeLearner(config, dataset)
         return SerialTreeLearner(config, dataset)
     if learner_type in ("feature", "data", "voting"):
         from ..parallel.learners import create_parallel_learner

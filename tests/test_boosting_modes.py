@@ -1,0 +1,95 @@
+"""DART and RF boosting-mode tests (dart.hpp / rf.hpp parity)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _make_binary(n=2000, f=10, seed=11):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    logit = 1.5 * X[:, 0] - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+    y = (logit + rng.randn(n) * 0.3 > 0).astype(np.float64)
+    return X, y
+
+
+def _make_regression(n=2000, f=8, seed=5):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = 2 * X[:, 0] - X[:, 1] + 0.3 * rng.randn(n)
+    return X, y
+
+
+def test_dart_trains_and_score_consistent():
+    X, y = _make_binary()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "boosting": "dart",
+                     "num_leaves": 15, "drop_rate": 0.3, "skip_drop": 0.3,
+                     "verbosity": -1}, ds, num_boost_round=20)
+    acc = np.mean((bst.predict(X) > 0.5) == y)
+    assert acc > 0.9, acc
+    # DART renormalization must keep the internal train score equal to a
+    # fresh prediction over the stored (renormalized) trees
+    internal = np.asarray(bst._gbdt.score[0])
+    fresh = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(internal, fresh, rtol=1e-3, atol=1e-3)
+
+
+def test_dart_xgboost_mode():
+    X, y = _make_binary(n=1000)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "boosting": "dart",
+                     "xgboost_dart_mode": True, "uniform_drop": True,
+                     "num_leaves": 7, "verbosity": -1}, ds, num_boost_round=10)
+    acc = np.mean((bst.predict(X) > 0.5) == y)
+    assert acc > 0.85, acc
+
+
+def test_rf_trains_binary():
+    X, y = _make_binary()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "boosting": "rf",
+                     "bagging_fraction": 0.6, "bagging_freq": 1,
+                     "num_leaves": 31, "verbosity": -1}, ds,
+                    num_boost_round=20)
+    acc = np.mean((bst.predict(X) > 0.5) == y)
+    assert acc > 0.9, acc
+
+
+def test_rf_average_output_roundtrip(tmp_path):
+    X, y = _make_regression()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "boosting": "rf",
+                     "bagging_fraction": 0.5, "bagging_freq": 1,
+                     "num_leaves": 31, "verbosity": -1}, ds,
+                    num_boost_round=15)
+    pred = bst.predict(X)
+    # averaged output should be in the label range, not the sum of 15 trees
+    assert abs(pred.mean() - y.mean()) < 1.0
+    r2 = 1 - np.mean((pred - y) ** 2) / np.var(y)
+    assert r2 > 0.6, r2
+    path = str(tmp_path / "rf.txt")
+    bst.save_model(path)
+    text = open(path).read()
+    assert "average_output" in text
+    re_pred = lgb.Booster(model_file=path).predict(X)
+    np.testing.assert_allclose(re_pred, pred, rtol=1e-5, atol=1e-5)
+
+
+def test_rf_score_is_average():
+    X, y = _make_binary(n=1200)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "boosting": "rf",
+                     "bagging_fraction": 0.5, "bagging_freq": 1,
+                     "num_leaves": 7, "verbosity": -1}, ds, num_boost_round=6)
+    internal = np.asarray(bst._gbdt.score[0])
+    fresh = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(internal, fresh, rtol=1e-3, atol=1e-3)
+
+
+def test_rf_requires_bagging():
+    X, y = _make_binary(n=500)
+    ds = lgb.Dataset(X, label=y)
+    with pytest.raises(Exception):
+        lgb.train({"objective": "binary", "boosting": "rf",
+                   "verbosity": -1}, ds, num_boost_round=2)

@@ -1,0 +1,90 @@
+"""Whole-tree-on-device learner: parity with the host-driven serial learner.
+
+The factory only selects DeviceTreeLearner on accelerators (its masked
+full-N histograms are MXU-cheap but CPU-slow), so these tests instantiate it
+directly on small data.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import Dataset as CoreDataset
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.treelearner.device import DeviceTreeLearner
+from lightgbm_tpu.treelearner.serial import SerialTreeLearner
+
+
+def _boosters(X, y, params, n_iters):
+    cfg = Config(params)
+    ds = CoreDataset.from_matrix(X, label=y, config=cfg)
+    out = []
+    for cls in (SerialTreeLearner, DeviceTreeLearner):
+        obj = create_objective(cfg.objective, cfg)
+        bst = GBDT(cfg, ds, obj)
+        bst.tree_learner = cls(cfg, ds)
+        for _ in range(n_iters):
+            if bst.train_one_iter():
+                break
+        out.append(bst)
+    return out
+
+
+@pytest.mark.parametrize("params", [
+    {"objective": "binary", "num_leaves": 15, "verbosity": -1},
+    {"objective": "binary", "num_leaves": 7, "max_depth": 3,
+     "min_data_in_leaf": 40, "verbosity": -1},
+    {"objective": "regression", "num_leaves": 15, "lambda_l1": 0.5,
+     "lambda_l2": 2.0, "verbosity": -1},
+])
+def test_device_matches_serial(rng, params):
+    X = rng.randn(1500, 8)
+    if params["objective"] == "binary":
+        y = (X[:, 0] - 0.7 * X[:, 1] + rng.randn(1500) * 0.3 > 0).astype(float)
+    else:
+        y = 2 * X[:, 0] - X[:, 1] + 0.2 * rng.randn(1500)
+    serial, device = _boosters(X, y, params, n_iters=6)
+    np.testing.assert_allclose(serial.predict(X, raw_score=True),
+                               device.predict(X, raw_score=True),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_device_with_bagging(rng):
+    X = rng.randn(1200, 8)
+    y = (X[:, 0] + rng.randn(1200) * 0.3 > 0).astype(float)
+    cfg = Config({"objective": "binary", "num_leaves": 7, "verbosity": -1})
+    ds = CoreDataset.from_matrix(X, label=y, config=cfg)
+    obj = create_objective("binary", cfg)
+    bst = GBDT(cfg, ds, obj)
+    bst.tree_learner = DeviceTreeLearner(cfg, ds)
+    import jax.numpy as jnp
+
+    grads, hesses = bst._grad_fn(bst.score[0])
+    gh = jnp.concatenate([jnp.stack([grads, hesses,
+                                     jnp.ones_like(grads)], axis=1),
+                          jnp.zeros((1, 3), jnp.float32)])
+    bag = np.sort(np.random.RandomState(0).choice(1200, 800, replace=False))
+    tree = bst.tree_learner.train(gh, bag)
+    assert tree.num_leaves > 1
+    part = bst.tree_learner.partition
+    total = sum(part.count(i) for i in range(tree.num_leaves))
+    assert total == 800
+    # out-of-bag rows keep leaf -1
+    assert (part.ids_host == -1).sum() == 400
+
+
+def test_device_stops_on_no_gain(rng):
+    # constant labels -> no positive gain -> single-leaf tree
+    X = rng.randn(400, 4)
+    y = np.ones(400)
+    cfg = Config({"objective": "regression", "num_leaves": 31,
+                  "boost_from_average": False, "verbosity": -1})
+    ds = CoreDataset.from_matrix(X, label=y, config=cfg)
+    obj = create_objective("regression", cfg)
+    bst = GBDT(cfg, ds, obj)
+    bst.tree_learner = DeviceTreeLearner(cfg, ds)
+    stop = bst.train_one_iter()
+    # first tree fits the mean; second should find nothing
+    stop2 = bst.train_one_iter()
+    assert stop or stop2

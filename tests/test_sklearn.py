@@ -1,0 +1,112 @@
+"""sklearn-API tests (subset of the reference's test_sklearn.py surface)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _xy_binary(n=1500, f=8, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] - 0.5 * X[:, 1] + rng.randn(n) * 0.3 > 0).astype(int)
+    return X, y
+
+
+def test_classifier_binary():
+    X, y = _xy_binary()
+    clf = lgb.LGBMClassifier(n_estimators=20, num_leaves=15)
+    clf.fit(X, y)
+    pred = clf.predict(X)
+    assert set(np.unique(pred)) <= {0, 1}
+    assert (pred == y).mean() > 0.9
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(y), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    assert clf.n_classes_ == 2
+    assert list(clf.classes_) == [0, 1]
+    assert clf.n_features_ == 8
+    assert clf.feature_importances_.shape == (8,)
+
+
+def test_classifier_multiclass_string_labels():
+    rng = np.random.RandomState(0)
+    X = rng.randn(900, 6)
+    y_int = np.argmax(X[:, :3] + rng.randn(900, 3) * 0.3, axis=1)
+    y = np.array(["a", "b", "c"])[y_int]
+    clf = lgb.LGBMClassifier(n_estimators=15, num_leaves=7)
+    clf.fit(X, y)
+    pred = clf.predict(X)
+    assert set(np.unique(pred)) <= {"a", "b", "c"}
+    assert (pred == y).mean() > 0.8
+    proba = clf.predict_proba(X)
+    assert proba.shape == (900, 3)
+
+
+def test_regressor_with_eval_set_early_stopping():
+    rng = np.random.RandomState(2)
+    X = rng.randn(2000, 8)
+    y = 2 * X[:, 0] - X[:, 1] + 0.2 * rng.randn(2000)
+    Xt, yt, Xv, yv = X[:1500], y[:1500], X[1500:], y[1500:]
+    reg = lgb.LGBMRegressor(n_estimators=100, num_leaves=15)
+    reg.fit(Xt, yt, eval_set=[(Xv, yv)],
+            callbacks=[lgb.early_stopping(5, verbose=False)])
+    assert reg.best_iteration_ > 0
+    pred = reg.predict(Xv)
+    r2 = 1 - np.mean((pred - yv) ** 2) / np.var(yv)
+    assert r2 > 0.9
+    assert "valid_0" in reg.evals_result_
+
+
+def test_regressor_sklearn_clone_and_params():
+    from sklearn.base import clone
+
+    reg = lgb.LGBMRegressor(n_estimators=5, num_leaves=7, reg_alpha=0.1)
+    reg2 = clone(reg)
+    assert reg2.get_params()["reg_alpha"] == 0.1
+    X, y = _xy_binary(300)
+    reg2.fit(X, y.astype(float))
+    assert reg2.predict(X).shape == (300,)
+
+
+def test_ranker():
+    rng = np.random.RandomState(3)
+    n_q, per_q = 40, 20
+    X = rng.randn(n_q * per_q, 6)
+    rel = (X[:, 0] + rng.randn(n_q * per_q) * 0.5)
+    y = np.clip((rel * 2).astype(int) - rel.astype(int).min(), 0, 4)
+    group = np.full(n_q, per_q)
+    rk = lgb.LGBMRanker(n_estimators=10, num_leaves=7)
+    rk.fit(X, y, group=group)
+    scores = rk.predict(X)
+    assert scores.shape == (n_q * per_q,)
+    # ranking scores should correlate with relevance
+    assert np.corrcoef(scores, y)[0, 1] > 0.5
+
+
+def test_ranker_requires_group():
+    X, y = _xy_binary(100)
+    with pytest.raises(ValueError):
+        lgb.LGBMRanker().fit(X, y)
+
+
+def test_plotting_smoke(tmp_path):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    X, y = _xy_binary(500)
+    record = {}
+    ds = lgb.Dataset(X, label=y.astype(float))
+    dv = lgb.Dataset(X[:100], label=y[:100].astype(float), reference=ds)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "metric": "binary_logloss", "verbosity": -1},
+                    ds, num_boost_round=5, valid_sets=[dv],
+                    callbacks=[lgb.record_evaluation(record)])
+    ax = lgb.plot_importance(bst)
+    assert ax is not None
+    ax = lgb.plot_metric(record)
+    assert ax is not None
+    ax = lgb.plot_tree(bst, tree_index=0)
+    assert ax is not None
+    used = int(np.argmax(bst.feature_importance()))
+    ax = lgb.plot_split_value_histogram(bst, used)
+    assert ax is not None
