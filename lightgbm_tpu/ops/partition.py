@@ -22,7 +22,7 @@ per bucket size).
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -82,6 +82,42 @@ def split_decision_bins(group_bins: jax.Array, decision: jax.Array) -> jax.Array
 
 
 @jax.jit
+def split_decision_bins_cat(group_bins: jax.Array, decision: jax.Array,
+                            cat_mask: jax.Array) -> jax.Array:
+    """go_left for a categorical split: membership of the (EFB-translated)
+    feature bin in the chosen bin set (CategoricalDecisionInner,
+    include/LightGBM/tree.h:375-388; unseen bins go right)."""
+    default_bin = decision[3].astype(jnp.int32)
+    lo = decision[5].astype(jnp.int32)
+    hi = decision[6].astype(jnp.int32)
+    is_efb = decision[7] > 0.5
+
+    gb = group_bins.astype(jnp.int32)
+    in_range = (gb >= lo) & (gb < hi)
+    shifted = gb - lo
+    natural = shifted + (shifted >= default_bin).astype(jnp.int32)
+    fbin = jnp.where(is_efb, jnp.where(in_range, natural, default_bin), gb)
+    B = cat_mask.shape[0]
+    return cat_mask[jnp.clip(fbin, 0, B - 1)] & (fbin < B)
+
+
+@jax.jit
+def partition_rows_cat(bins_row: jax.Array, row_idx: jax.Array,
+                       count: jax.Array, decision: jax.Array,
+                       cat_mask: jax.Array, n_data: int
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """partition_rows with a categorical bin-set decision."""
+    P = row_idx.shape[0]
+    valid = jnp.arange(P) < count
+    gb = jnp.take(bins_row, jnp.minimum(row_idx, n_data - 1))
+    go_left = split_decision_bins_cat(gb, decision, cat_mask) & valid
+    key = jnp.where(go_left, 0, jnp.where(valid, 1, 2)).astype(jnp.int32)
+    order = jnp.argsort(key, stable=True)
+    sorted_idx = jnp.where(jnp.arange(P) < count, row_idx[order], n_data)
+    return sorted_idx, go_left.sum()
+
+
+@jax.jit
 def partition_rows(bins_row: jax.Array, row_idx: jax.Array, count: jax.Array,
                    decision: jax.Array, n_data: int
                    ) -> Tuple[jax.Array, jax.Array]:
@@ -126,14 +162,21 @@ class RowPartition:
         return self.leaf_count[leaf]
 
     def split(self, leaf: int, new_leaf: int, bins_row: jax.Array,
-              decision: jax.Array) -> Tuple[int, int]:
+              decision: jax.Array,
+              cat_mask: Optional[jax.Array] = None) -> Tuple[int, int]:
         """Split `leaf` in place; left stays as `leaf`, right becomes
-        `new_leaf`. Returns (left_count, right_count)."""
+        `new_leaf`. Returns (left_count, right_count). cat_mask selects the
+        categorical bin-membership decision."""
         idx = self.leaf_idx[leaf]
         cnt = self.leaf_count[leaf]
-        sorted_idx, left_cnt_dev = partition_rows(
-            bins_row, idx, jnp.asarray(cnt, dtype=jnp.int32), decision,
-            self.num_data)
+        if cat_mask is not None:
+            sorted_idx, left_cnt_dev = partition_rows_cat(
+                bins_row, idx, jnp.asarray(cnt, dtype=jnp.int32), decision,
+                cat_mask, self.num_data)
+        else:
+            sorted_idx, left_cnt_dev = partition_rows(
+                bins_row, idx, jnp.asarray(cnt, dtype=jnp.int32), decision,
+                self.num_data)
         left_cnt = int(left_cnt_dev)  # the one host sync per split
         right_cnt = cnt - left_cnt
         lp = bucket_size(left_cnt, self.min_bucket)

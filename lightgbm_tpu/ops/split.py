@@ -58,19 +58,21 @@ class FeatureMeta:
     real_feature: List[int]  # dense idx -> original feature index
     max_bins: int
     hist_rows: int  # rows in the flattened group-hist (without sentinel)
+    has_categorical: bool = False  # static: gates the categorical scan
 
     def tree_flatten(self):
         return ((self.gather_index, self.valid_slot, self.default_bin,
                  self.efb_omitted, self.missing_type, self.nbins,
                  self.is_categorical, self.monotone, self.penalty),
-                (self.real_feature, self.max_bins, self.hist_rows))
+                (self.real_feature, self.max_bins, self.hist_rows,
+                 self.has_categorical))
 
 
 jax.tree_util.register_pytree_node(
     FeatureMeta,
     FeatureMeta.tree_flatten,
     lambda aux, ch: FeatureMeta(*ch, real_feature=aux[0], max_bins=aux[1],
-                                hist_rows=aux[2]),
+                                hist_rows=aux[2], has_categorical=aux[3]),
 )
 
 
@@ -135,6 +137,7 @@ def make_feature_meta(dataset, group_bin_padded: int) -> FeatureMeta:
         real_feature=list(feats),
         max_bins=Bmax,
         hist_rows=G * group_bin_padded,
+        has_categorical=bool(is_cat.any()),
     )
 
 
@@ -177,6 +180,7 @@ def pad_feature_meta(meta: FeatureMeta, f_pad: int) -> FeatureMeta:
         real_feature=list(meta.real_feature) + [-1] * pad,
         max_bins=meta.max_bins,
         hist_rows=meta.hist_rows,
+        has_categorical=meta.has_categorical,
     )
 
 
@@ -202,11 +206,14 @@ def leaf_gain(sum_grad, sum_hess, l1, l2, max_delta_step):
     return leaf_gain_given_output(sum_grad, sum_hess, l1, l2, out)
 
 
-# Packed best-split record layout (device -> host, one sync per leaf):
+# Packed best-split record layout (device -> host, one sync per leaf).
+# For categorical splits: threshold_bin holds the one-hot bin (cat_dir=0) or
+# the sorted-subset prefix LENGTH (cat_dir=+/-1 giving the scan direction);
+# the host re-derives the bin set from the feature's histogram row.
 SPLIT_FIELDS = ["gain", "feature", "threshold_bin", "default_left",
                 "left_sum_g", "left_sum_h", "left_count",
                 "right_sum_g", "right_sum_h", "right_count",
-                "left_output", "right_output"]
+                "left_output", "right_output", "is_cat", "cat_dir"]
 
 
 @dataclass
@@ -226,6 +233,7 @@ class SplitInfo:
     left_output: float = 0.0
     right_output: float = 0.0
     is_categorical: bool = False
+    cat_dir: int = 0  # 0 = one-hot; +/-1 = sorted-subset scan direction
     cat_bitset_bins: Optional[List[int]] = None  # bin-space bitset words
 
     @property
@@ -234,12 +242,16 @@ class SplitInfo:
 
     @classmethod
     def from_packed(cls, vec: np.ndarray) -> "SplitInfo":
-        return cls(gain=float(vec[0]), feature=int(vec[1]),
-                   threshold_bin=int(vec[2]), default_left=bool(vec[3] > 0.5),
-                   left_sum_g=float(vec[4]), left_sum_h=float(vec[5]),
-                   left_count=int(round(vec[6])), right_sum_g=float(vec[7]),
-                   right_sum_h=float(vec[8]), right_count=int(round(vec[9])),
-                   left_output=float(vec[10]), right_output=float(vec[11]))
+        out = cls(gain=float(vec[0]), feature=int(vec[1]),
+                  threshold_bin=int(vec[2]), default_left=bool(vec[3] > 0.5),
+                  left_sum_g=float(vec[4]), left_sum_h=float(vec[5]),
+                  left_count=int(round(vec[6])), right_sum_g=float(vec[7]),
+                  right_sum_h=float(vec[8]), right_count=int(round(vec[9])),
+                  left_output=float(vec[10]), right_output=float(vec[11]))
+        if len(vec) > 13:
+            out.is_categorical = bool(vec[12] > 0.5)
+            out.cat_dir = int(round(vec[13]))
+        return out
 
 
 @partial(jax.jit, static_argnames=())
@@ -259,7 +271,8 @@ def gather_feature_hist(hist: jax.Array, meta: FeatureMeta,
 
 
 def per_feature_best(fh: jax.Array, totals: jax.Array, meta: FeatureMeta,
-                     params: jax.Array) -> jax.Array:
+                     params: jax.Array,
+                     feature_mask: Optional[jax.Array] = None) -> jax.Array:
     """Best split per feature: [F, len(SPLIT_FIELDS)] records.
 
     fh:     [F, Bmax, 3] feature histograms (after gather_feature_hist)
@@ -307,6 +320,8 @@ def per_feature_best(fh: jax.Array, totals: jax.Array, meta: FeatureMeta,
         ok &= tpos < (meta.nbins[:, None] - 1)
         ok &= meta.valid_slot
         ok &= ~meta.is_categorical[:, None]
+        if feature_mask is not None:
+            ok &= feature_mask[:, None]
         if lane == 1:
             ok &= has_missing[:, None]
         gain = (leaf_gain(lg, lh, l1, l2, max_delta)
@@ -337,14 +352,186 @@ def per_feature_best(fh: jax.Array, totals: jax.Array, meta: FeatureMeta,
     out_gain = jnp.where(is_valid, best_gain - gain_shift, -jnp.inf)
     lout = leaf_output(lg, lh, l1, l2, max_delta)
     rout = leaf_output(rg, rh, l1, l2, max_delta)
+    zeros = jnp.zeros_like(out_gain)
     # default_left lane semantics: lane 1 sends the missing bin left
     return jnp.stack([
         out_gain,
         jnp.where(is_valid, rows.astype(jnp.float32), -1.0),
         t_b.astype(jnp.float32),
         lane_b.astype(jnp.float32),
-        lg, lh, lc, rg, rh, rc, lout, rout,
+        lg, lh, lc, rg, rh, rc, lout, rout, zeros, zeros,
     ], axis=1)
+
+
+def per_feature_best_categorical(fh: jax.Array, totals: jax.Array,
+                                 meta: FeatureMeta, params: jax.Array,
+                                 feature_mask: Optional[jax.Array] = None
+                                 ) -> jax.Array:
+    """Best categorical split per feature: [F, len(SPLIT_FIELDS)] records.
+
+    Counterpart of FindBestThresholdCategoricalInner
+    (src/treelearner/feature_histogram.cpp:147-241):
+
+      * one-hot when num_bin <= max_cat_to_onehot: every single bin is a
+        left-set candidate (plain lambda_l2);
+      * sorted-subset otherwise: bins with count >= cat_smooth, ordered by
+        grad/(hess + cat_smooth), scanned as prefixes from both ends up to
+        min(max_cat_threshold, (used+1)/2) categories, with lambda_l2+cat_l2
+        and min_data_per_group throttling.
+
+    Bin counts come from the histogram's exact count channel (the reference
+    reconstructs them as RoundInt(hess * num_data / sum_hessian)). Only the
+    prefix length + direction are recorded; the host re-derives the bin set
+    from the same f32 ctr ordering (stable argsort on identical values).
+    """
+    l1, l2, min_data, min_hess, min_gain, max_delta = (
+        params[0], params[1], params[2], params[3], params[4], params[5])
+    max_onehot, max_cat_thresh = params[6], params[7]
+    cat_l2, cat_smooth, min_group = params[8], params[9], params[10]
+    F, Bmax, _ = fh.shape
+    rows = jnp.arange(F)
+    total_g, total_h, total_cnt = totals[0], totals[1], totals[2]
+    gain_shift = leaf_gain(total_g, total_h, l1, l2, max_delta) + min_gain
+    neg_inf = jnp.float32(-jnp.inf)
+    eps = jnp.float32(K_EPSILON)
+
+    g, h, c = fh[..., 0], fh[..., 1], fh[..., 2]
+    bin_valid = meta.valid_slot & (jnp.arange(Bmax)[None, :]
+                                   < meta.nbins[:, None])
+
+    # ---- one-hot lane (each bin alone goes left)
+    other_h = total_h - h - eps
+    other_c = total_cnt - c
+    ok1 = bin_valid & (c >= min_data) & (h >= min_hess) & \
+        (other_c >= min_data) & (other_h >= min_hess)
+    gain1 = (leaf_gain(total_g - g, other_h, l1, l2, max_delta)
+             + leaf_gain(g, h + eps, l1, l2, max_delta))
+    gain1 = jnp.where(ok1, gain1, neg_inf)
+    onehot_t = jnp.argmax(gain1, axis=1)
+    onehot_gain = jnp.take_along_axis(gain1, onehot_t[:, None], axis=1)[:, 0]
+    onehot_lg = g[rows, onehot_t]
+    onehot_lh = h[rows, onehot_t] + eps
+    onehot_lc = c[rows, onehot_t]
+
+    # ---- sorted-subset lane
+    l2c = l2 + cat_l2
+    eligible = bin_valid & (c >= cat_smooth)
+    ctr = jnp.where(eligible, g / (h + cat_smooth), jnp.inf)
+    order = jnp.argsort(ctr, axis=1, stable=True)  # eligible first (asc)
+    used = eligible.sum(axis=1)  # [F]
+    sg = jnp.take_along_axis(g, order, axis=1)
+    sh = jnp.take_along_axis(h, order, axis=1)
+    sc = jnp.take_along_axis(c, order, axis=1)
+    max_num_cat = jnp.minimum(max_cat_thresh, (used + 1) // 2)  # [F]
+
+    def direction_scan(sgd, shd, scd):
+        """Prefix scan in sorted order; returns (best_gain, best_len, best
+        left stats) per feature. sgd/shd/scd: [F, Bmax] stats in scan order."""
+        clg = jnp.cumsum(sgd, axis=1)
+        clh = jnp.cumsum(shd, axis=1) + eps
+        clc = jnp.cumsum(scd, axis=1)
+        pos = jnp.arange(Bmax)[None, :].astype(jnp.float32)
+        in_range = (pos < used[:, None]) & (pos < max_num_cat[:, None])
+        rh = total_h - clh
+        rc = total_cnt - clc
+        ok = in_range & (clc >= min_data) & (clh >= min_hess) & \
+            (rc >= min_data) & (rc >= min_group) & (rh >= min_hess)
+        # min_data_per_group throttling: the reference requires >= min_group
+        # rows accumulated since the last evaluated prefix; approximated
+        # here as cumulative count >= min_group (vector-friendly and equal
+        # for the common leading-prefix case)
+        ok &= clc >= min_group
+        gains = (leaf_gain(clg, clh, l1, l2c, max_delta)
+                 + leaf_gain(total_g - clg, rh, l1, l2c, max_delta))
+        gains = jnp.where(ok, gains, neg_inf)
+        best_i = jnp.argmax(gains, axis=1)
+        best_gain = jnp.take_along_axis(gains, best_i[:, None], axis=1)[:, 0]
+        blg = clg[rows, best_i]
+        blh = clh[rows, best_i]
+        blc = clc[rows, best_i]
+        return best_gain, best_i + 1, blg, blh, blc
+
+    fwd = direction_scan(sg, sh, sc)
+    # backward lane: reversal puts the ineligible (inf-keyed) padding first,
+    # so roll each row back by (Bmax - used) to start at the LAST eligible bin
+    shift = (Bmax - used)[:, None]
+    idx = (jnp.arange(Bmax)[None, :] + shift) % Bmax
+    bwd_stats = tuple(jnp.take_along_axis(a, idx, axis=1)
+                      for a in (sg[:, ::-1], sh[:, ::-1], sc[:, ::-1]))
+    bwd = direction_scan(*bwd_stats)
+
+    use_onehot = meta.nbins <= max_onehot
+    lanes_gain = jnp.stack([
+        jnp.where(use_onehot, onehot_gain, neg_inf),
+        jnp.where(use_onehot, neg_inf, fwd[0]),
+        jnp.where(use_onehot, neg_inf, bwd[0]),
+    ], axis=1)  # [F, 3]
+    lane = jnp.argmax(lanes_gain, axis=1)
+    best_gain = jnp.take_along_axis(lanes_gain, lane[:, None], axis=1)[:, 0]
+
+    def pick(a_one, a_fwd, a_bwd):
+        stack = jnp.stack([a_one, a_fwd, a_bwd], axis=1)
+        return stack[rows, lane]
+
+    thresh = pick(onehot_t.astype(jnp.float32),
+                  fwd[1].astype(jnp.float32), bwd[1].astype(jnp.float32))
+    lg = pick(onehot_lg, fwd[2], bwd[2])
+    lh = pick(onehot_lh, fwd[3], bwd[3])
+    lc = pick(onehot_lc, fwd[4], bwd[4])
+    cat_dir = pick(jnp.zeros(F), jnp.ones(F), -jnp.ones(F))
+    l2_eff = jnp.where(lane == 0, l2, l2c)
+
+    rg, rh, rc = total_g - lg, total_h - lh, total_cnt - lc
+    is_valid = (meta.is_categorical & jnp.isfinite(best_gain)
+                & (best_gain > gain_shift))
+    if feature_mask is not None:
+        is_valid &= feature_mask
+    out_gain = jnp.where(is_valid, best_gain - gain_shift, neg_inf)
+    lout = leaf_output(lg, lh, l1, l2_eff, max_delta)
+    rout = leaf_output(rg, rh, l1, l2_eff, max_delta)
+    return jnp.stack([
+        out_gain,
+        jnp.where(is_valid, rows.astype(jnp.float32), -1.0),
+        thresh,
+        jnp.zeros(F),  # default_left = false (CategoricalDecision)
+        lg, lh, lc, rg, rh, rc, lout, rout,
+        jnp.ones(F), cat_dir,
+    ], axis=1)
+
+
+def derive_cat_left_bins(bin_stats: np.ndarray, nbins: int, split: SplitInfo,
+                         cat_smooth: float) -> List[int]:
+    """Re-derive the winning categorical left-bin set on host from the
+    feature's histogram row.
+
+    Replays the device scan's f32 ctr computation and stable argsort on the
+    SAME values, so the permutation matches bit-for-bit; only the prefix
+    length + direction travel in the packed record.
+    """
+    if split.cat_dir == 0:
+        return [int(split.threshold_bin)]
+    g = np.asarray(bin_stats[:nbins, 0], dtype=np.float32)
+    h = np.asarray(bin_stats[:nbins, 1], dtype=np.float32)
+    c = np.asarray(bin_stats[:nbins, 2], dtype=np.float32)
+    smooth = np.float32(cat_smooth)
+    eligible = c >= smooth
+    ctr = np.where(eligible, g / (h + smooth), np.float32(np.inf))
+    order = np.argsort(ctr, kind="stable")
+    used = int(eligible.sum())
+    k = min(int(split.threshold_bin), used)
+    chosen = order[:k] if split.cat_dir > 0 else order[used - k: used]
+    return [int(b) for b in chosen]
+
+
+def bins_to_bitset(values: List[int]) -> List[int]:
+    """Pack non-negative ints into 32-bit bitset words (Common::ConstructBitset)."""
+    vals = [v for v in values if v >= 0]
+    if not vals:
+        return [0]
+    words = [0] * (max(vals) // 32 + 1)
+    for v in vals:
+        words[v // 32] |= 1 << (v % 32)
+    return words
 
 
 def reduce_best_record(recs: jax.Array) -> jax.Array:
@@ -355,13 +542,19 @@ def reduce_best_record(recs: jax.Array) -> jax.Array:
 
 @partial(jax.jit, static_argnames=())
 def find_best_split(hist: jax.Array, totals: jax.Array, meta: FeatureMeta,
-                    params: jax.Array) -> jax.Array:
-    """Best numerical split across all features for one leaf.
+                    params: jax.Array,
+                    feature_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Best split across all features for one leaf.
 
     hist:   [G, Bg, 3] group histogram for the leaf
     totals: [3] leaf (sum_grad, sum_hess, count)
+    feature_mask: optional [F] bool (ColSampler / interaction constraints)
     Returns packed split record [len(SPLIT_FIELDS)] float32.
     """
     fh = gather_feature_hist(hist, meta, totals)  # [F, Bmax, 3]
-    recs = per_feature_best(fh, totals, meta, params)
+    recs = per_feature_best(fh, totals, meta, params, feature_mask)
+    if meta.has_categorical:  # static flag: skip the scan entirely otherwise
+        cat_recs = per_feature_best_categorical(fh, totals, meta, params,
+                                                feature_mask)
+        recs = jnp.concatenate([recs, cat_recs])
     return reduce_best_record(recs)

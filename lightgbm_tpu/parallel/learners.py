@@ -54,8 +54,8 @@ def _make_feature_scan_fn(mesh, f_local):
     its block, offsets local feature indices, all_gathers the packed records
     and reduces to the global best (SyncUpGlobalBestSplit)."""
 
-    def scan_block(fh_blk, totals, params, scan_meta_sh):
-        recs = per_feature_best(fh_blk, totals, scan_meta_sh, params)
+    def scan_block(fh_blk, totals, params, scan_meta_sh, mask_sh):
+        recs = per_feature_best(fh_blk, totals, scan_meta_sh, params, mask_sh)
         off = (jax.lax.axis_index("data") * f_local).astype(jnp.float32)
         feat = recs[:, 1]
         recs = recs.at[:, 1].set(jnp.where(feat >= 0, feat + off, -1.0))
@@ -64,7 +64,7 @@ def _make_feature_scan_fn(mesh, f_local):
 
     return jax.jit(jax.shard_map(
         scan_block, mesh=mesh,
-        in_specs=(P("data"), P(), P(), P("data")), out_specs=P(),
+        in_specs=(P("data"), P(), P(), P("data"), P("data")), out_specs=P(),
         check_vma=False))
 
 
@@ -191,6 +191,14 @@ class DataParallelTreeLearner(SerialTreeLearner):
         self.leaf_id = jax.device_put(ids, NamedSharding(self.mesh, P("data")))
         self.partition = LeafIdPartition(self)
         self.partition.counts[0] = int(in_bag.sum())
+        # tree-level column sampling (per-node masks would need a transfer
+        # per leaf; the distributed learners sample per tree only)
+        F = len(self.meta.real_feature)
+        mask = np.ones(self.f_pad, dtype=bool)
+        if self.col_sampler.active:
+            mask[:F] = self.col_sampler.reset_by_tree()
+        self._mask_padded = jax.device_put(
+            mask, NamedSharding(self.mesh, P("data")))
 
     def _leaf_hist(self, leaf: int) -> jax.Array:
         return self._fh_block_fn(self.bins_dev, self._gh_sh, self.leaf_id,
@@ -203,11 +211,16 @@ class DataParallelTreeLearner(SerialTreeLearner):
     def _search_split(self, state: _LeafState) -> SplitInfo:
         rec = self._scan_fn(state.hist,
                             jnp.asarray(state.totals, dtype=jnp.float32),
-                            self.params_dev, self.scan_meta_sharded)
+                            self.params_dev, self.scan_meta_sharded,
+                            self._mask_padded)
         return SplitInfo.from_packed(np.asarray(rec))
 
     def _partition_split(self, leaf: int, new_leaf: int, gi: int,
-                         decision: jax.Array) -> Tuple[int, int]:
+                         decision: jax.Array,
+                         cat_mask=None) -> Tuple[int, int]:
+        # categorical splits are masked out of the distributed scans for now
+        # (per_feature_best's ok &= ~is_categorical), so cat_mask never flows
+        assert cat_mask is None
         new_ids, left_dev = self._partition_fn(
             self.bins_dev, self.leaf_id, decision, jnp.int32(gi),
             jnp.int32(leaf), jnp.int32(new_leaf))
@@ -236,10 +249,20 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         self._scan_fn = _make_feature_scan_fn(self.mesh, self.f_local)
         self._gather_fn = jax.jit(gather_feature_hist)
 
+    def _begin_tree(self, gh_ext, bag_indices) -> None:
+        super()._begin_tree(gh_ext, bag_indices)
+        F = len(self.meta.real_feature)
+        mask = np.ones(self.f_pad, dtype=bool)
+        if self._tree_feature_mask is not None:
+            mask[:F] = np.asarray(self._tree_feature_mask)
+        self._mask_padded = jax.device_put(
+            mask, NamedSharding(self.mesh, P("data")))
+
     def _search_split(self, state: _LeafState) -> SplitInfo:
         totals = jnp.asarray(state.totals, dtype=jnp.float32)
         fh = self._gather_fn(state.hist, self.meta_pad, totals)
-        rec = self._scan_fn(fh, totals, self.params_dev, self.scan_meta_sharded)
+        rec = self._scan_fn(fh, totals, self.params_dev,
+                            self.scan_meta_sharded, self._mask_padded)
         return SplitInfo.from_packed(np.asarray(rec))
 
 
@@ -275,12 +298,13 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             in_specs=(P(None, "data"), P("data"), P("data"), P()),
             out_specs=P("data")))
 
-        def vote_scan(local_hist_blk, totals, params, meta_full, scan_meta_full):
+        def vote_scan(local_hist_blk, totals, params, meta_full,
+                      scan_meta_full, mask_full):
             lh = local_hist_blk[0]  # this device's [G, Bpad, 3]
             local_tot = lh[0].sum(axis=0)
             fh_local = gather_feature_hist(lh, meta_full, local_tot)
             local_recs = per_feature_best(fh_local, local_tot,
-                                          scan_meta_full, params)
+                                          scan_meta_full, params, mask_full)
             # phase 1: local proposal of top-k features by local gain
             _, topk_idx = jax.lax.top_k(local_recs[:, 0], k_local)
             votes = jax.lax.all_gather(topk_idx, "data", tiled=True)
@@ -299,7 +323,7 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
 
         self._vote_scan_fn = jax.jit(jax.shard_map(
             vote_scan, mesh=mesh,
-            in_specs=(P("data"), P(), P(), P(), P()), out_specs=P(),
+            in_specs=(P("data"), P(), P(), P(), P(), P()), out_specs=P(),
             check_vma=False))
 
     def _leaf_hist(self, leaf: int) -> jax.Array:
@@ -307,10 +331,14 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
                                    jnp.int32(leaf))
 
     def _search_split(self, state: _LeafState) -> SplitInfo:
+        mask_full = jnp.ones(self.f_pad, dtype=bool)
+        if self.col_sampler.active:
+            mask_full = mask_full.at[: len(self.meta.real_feature)].set(
+                jnp.asarray(np.asarray(self.col_sampler._tree_mask)))
         rec = self._vote_scan_fn(state.hist,
                                  jnp.asarray(state.totals, dtype=jnp.float32),
                                  self.params_dev, self.meta_pad,
-                                 self.scan_meta_full)
+                                 self.scan_meta_full, mask_full)
         return SplitInfo.from_packed(np.asarray(rec))
 
 

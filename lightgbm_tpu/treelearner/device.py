@@ -104,6 +104,7 @@ def _decide_go_left(gb, thresh, default_left, missing_type, default_bin,
 @partial(jax.jit, static_argnames=("num_leaves", "num_bins", "max_depth"))
 def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
                         meta, tables: FeatureTables, params: jax.Array,
+                        feature_mask: jax.Array,
                         num_leaves: int, num_bins: int, max_depth: int):
     """Grow one leaf-wise tree fully on device.
 
@@ -135,7 +136,8 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
     totals = jnp.zeros((L + 1, 3), jnp.float32).at[0].set(root_tot)
     depth = jnp.zeros(L + 1, jnp.int32)
     leaf_best = jnp.full((L + 1, REC), neg_inf, jnp.float32)
-    root_rec = guard(find_best_split(root_hist, root_tot, meta, params),
+    root_rec = guard(find_best_split(root_hist, root_tot, meta, params,
+                                     feature_mask),
                      root_tot[2], root_tot[1], jnp.int32(0))
     leaf_best = leaf_best.at[0].set(root_rec)
     rec_store = jnp.zeros((max(L - 1, 1), STORE), jnp.float32)
@@ -165,9 +167,11 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         ltot = left_hist[0].sum(axis=0)
         rtot = totals[best_leaf] - ltot
         ndepth = depth[best_leaf] + 1
-        lrec = guard(find_best_split(left_hist, ltot, meta, params),
+        lrec = guard(find_best_split(left_hist, ltot, meta, params,
+                                     feature_mask),
                      ltot[2], ltot[1], ndepth)
-        rrec = guard(find_best_split(right_hist, rtot, meta, params),
+        rrec = guard(find_best_split(right_hist, rtot, meta, params,
+                                     feature_mask),
                      rtot[2], rtot[1], ndepth)
 
         # parent output for the tree's internal_value bookkeeping
@@ -250,10 +254,14 @@ class DeviceTreeLearner(SerialTreeLearner):
         else:
             leaf_id0 = jnp.zeros(self.num_data, dtype=jnp.int32)
 
+        if self.col_sampler.active:
+            fmask = jnp.asarray(self.col_sampler.reset_by_tree())
+        else:
+            fmask = jnp.ones(len(self.meta.real_feature), dtype=bool)
         with global_timer.scope("tree_device"):
             rec_store, leaf_id, _ = grow_tree_on_device(
                 self.bins_dev, gh, leaf_id0, self.meta, self.tables,
-                self.params_dev, num_leaves, self.group_bin_padded,
+                self.params_dev, fmask, num_leaves, self.group_bin_padded,
                 cfg.max_depth)
             rec_np = np.asarray(rec_store)  # the one transfer per tree
 

@@ -30,8 +30,10 @@ from ..io.dataset import Dataset
 from ..models.tree import Tree
 from ..ops.histogram import build_histogram_rows, subtract_histogram
 from ..ops.partition import RowPartition
-from ..ops.split import (FeatureMeta, SplitInfo, find_best_split,
+from ..ops.split import (FeatureMeta, SplitInfo, bins_to_bitset,
+                         derive_cat_left_bins, find_best_split,
                          make_feature_meta)
+from .col_sampler import ColSampler
 from ..utils.log import Log
 from ..utils.timer import global_timer
 
@@ -42,6 +44,7 @@ class _LeafState:
     totals: Tuple[float, float, float]  # (sum_g, sum_h, count)
     split: Optional[SplitInfo]
     depth: int
+    features_in_path: frozenset = frozenset()  # real indices (interaction constraints)
 
 
 class SerialTreeLearner:
@@ -57,8 +60,13 @@ class SerialTreeLearner:
             config.lambda_l1, config.lambda_l2,
             float(config.min_data_in_leaf), config.min_sum_hessian_in_leaf,
             config.min_gain_to_split, config.max_delta_step,
+            float(config.max_cat_to_onehot), float(config.max_cat_threshold),
+            config.cat_l2, config.cat_smooth,
+            float(config.min_data_per_group),
         ], dtype=jnp.float32)
         self.partition: Optional[RowPartition] = None
+        self.col_sampler = ColSampler(config, self.meta.real_feature)
+        self._tree_feature_mask: Optional[jax.Array] = None
 
     # ------------------------------------------------------------------ train
 
@@ -111,6 +119,11 @@ class SerialTreeLearner:
         if bag_indices is not None:
             partition.set_used_indices(bag_indices)
         self.partition = partition
+        if self.col_sampler.active:
+            self._tree_feature_mask = jnp.asarray(
+                self.col_sampler.reset_by_tree())
+        else:
+            self._tree_feature_mask = None
 
     def _leaf_hist(self, leaf: int) -> jax.Array:
         return build_histogram_rows(
@@ -121,15 +134,26 @@ class SerialTreeLearner:
         # any group's bins partition all rows, so group 0's bin-sum = totals
         return tuple(float(x) for x in np.asarray(root_hist[0].sum(axis=0)))
 
+    def _node_feature_mask(self, state: "_LeafState") -> Optional[jax.Array]:
+        cs = self.col_sampler
+        if not cs.active:
+            return None
+        if cs.fraction_bynode < 1.0 or cs.constraints:
+            return jnp.asarray(cs.get_by_node(set(state.features_in_path)))
+        return self._tree_feature_mask
+
     def _search_split(self, state: "_LeafState") -> SplitInfo:
         rec = find_best_split(
             state.hist, jnp.asarray(state.totals, dtype=jnp.float32),
-            self.meta, self.params_dev)
+            self.meta, self.params_dev, self._node_feature_mask(state))
         return SplitInfo.from_packed(np.asarray(rec))
 
     def _partition_split(self, leaf: int, new_leaf: int, gi: int,
-                         decision: jax.Array) -> Tuple[int, int]:
-        return self.partition.split(leaf, new_leaf, self.bins_dev[gi], decision)
+                         decision: jax.Array,
+                         cat_mask: Optional[jax.Array] = None
+                         ) -> Tuple[int, int]:
+        return self.partition.split(leaf, new_leaf, self.bins_dev[gi],
+                                    decision, cat_mask)
 
     # --------------------------------------------------------------- internal
 
@@ -161,22 +185,48 @@ class SerialTreeLearner:
         state = frontier[leaf]
         new_leaf = tree.num_leaves
 
-        # 1. record the split in the tree (real-value threshold)
-        threshold_double = mapper.bin_to_value(split.threshold_bin)
+        # 1. record the split in the tree (real-value threshold / bitset)
         parent_output = _leaf_output_host(
             state.totals[0], state.totals[1],
             self.config.lambda_l1, self.config.lambda_l2,
             self.config.max_delta_step)
-        tree.split(leaf=leaf, feature_inner=dense_f, real_feature=real_f,
-                   threshold_bin=split.threshold_bin,
-                   threshold_double=threshold_double,
-                   default_left=split.default_left,
-                   missing_type=mapper.missing_type,
-                   gain=split.gain,
-                   left_value=split.left_output, right_value=split.right_output,
-                   left_count=split.left_count, right_count=split.right_count,
-                   left_weight=split.left_sum_h, right_weight=split.right_sum_h,
-                   parent_value=parent_output)
+        cat_mask = None
+        if split.is_categorical:
+            # categorical features are never EFB-bundled, so the feature's
+            # histogram row IS the group's
+            bin_stats = np.asarray(state.hist[gi])
+            left_bins = derive_cat_left_bins(
+                bin_stats, mapper.num_bin, split, self.config.cat_smooth)
+            split.cat_bitset_bins = left_bins
+            cat_values = [mapper.bin_2_categorical[b] for b in left_bins
+                          if 0 <= b < len(mapper.bin_2_categorical)]
+            tree.split_categorical(
+                leaf=leaf, feature_inner=dense_f, real_feature=real_f,
+                bin_bitset=bins_to_bitset(left_bins),
+                value_bitset=bins_to_bitset(cat_values),
+                missing_type=mapper.missing_type, gain=split.gain,
+                left_value=split.left_output, right_value=split.right_output,
+                left_count=split.left_count, right_count=split.right_count,
+                left_weight=split.left_sum_h, right_weight=split.right_sum_h,
+                parent_value=parent_output)
+            mask = np.zeros(self.group_bin_padded, dtype=bool)
+            mask[np.asarray(left_bins, dtype=np.int64)] = True
+            cat_mask = jnp.asarray(mask)
+        else:
+            threshold_double = mapper.bin_to_value(split.threshold_bin)
+            tree.split(leaf=leaf, feature_inner=dense_f, real_feature=real_f,
+                       threshold_bin=split.threshold_bin,
+                       threshold_double=threshold_double,
+                       default_left=split.default_left,
+                       missing_type=mapper.missing_type,
+                       gain=split.gain,
+                       left_value=split.left_output,
+                       right_value=split.right_output,
+                       left_count=split.left_count,
+                       right_count=split.right_count,
+                       left_weight=split.left_sum_h,
+                       right_weight=split.right_sum_h,
+                       parent_value=parent_output)
 
         # 2. partition rows (one host sync for the left count)
         decision = jnp.asarray([
@@ -187,7 +237,7 @@ class SerialTreeLearner:
         ], dtype=jnp.float32)
         with global_timer.scope("partition"):
             left_cnt, right_cnt = self._partition_split(
-                leaf, new_leaf, gi, decision)
+                leaf, new_leaf, gi, decision, cat_mask)
         if left_cnt != split.left_count or right_cnt != split.right_count:
             Log.debug("Partition count mismatch at leaf %d: %d/%d vs %d/%d",
                       leaf, left_cnt, right_cnt, split.left_count, split.right_count)
@@ -238,9 +288,16 @@ def create_tree_learner(learner_type: str, device_type: str, config: Config,
             on_accelerator = jax.default_backend() not in ("cpu",)
         except RuntimeError:
             on_accelerator = False
-        if (device_type != "cpu" and on_accelerator and pool_bytes(
-                config.num_leaves, dataset.num_groups,
-                int(max(dataset.group_bin_counts().max(), 2))
+        has_cat = any(dataset.mappers[f].bin_type == 1
+                      for f in dataset.used_features)
+        # per-node feature masks need the host-driven loop for now
+        needs_host = (config.feature_fraction_bynode < 1.0
+                      or bool(config.interaction_constraints))
+        if (device_type != "cpu" and on_accelerator and not has_cat
+                and not needs_host
+                and pool_bytes(
+                    config.num_leaves, dataset.num_groups,
+                    int(max(dataset.group_bin_counts().max(), 2))
                 ) <= POOL_BYTE_LIMIT):
             return DeviceTreeLearner(config, dataset)
         return SerialTreeLearner(config, dataset)

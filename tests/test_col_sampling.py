@@ -1,0 +1,81 @@
+"""feature_fraction / feature_fraction_bynode / interaction_constraints
+(col_sampler.hpp parity)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _xy(n=1500, f=10, seed=21):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] - X[:, 1] + 0.5 * X[:, 2] + rng.randn(n) * 0.3 > 0
+         ).astype(np.float64)
+    return X, y
+
+
+def _used_features(bst):
+    return set(np.nonzero(bst.feature_importance())[0])
+
+
+def test_feature_fraction_trains():
+    X, y = _xy()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "feature_fraction": 0.5, "verbosity": -1},
+                    ds, num_boost_round=20)
+    acc = np.mean((bst.predict(X) > 0.5) == y)
+    assert acc > 0.9, acc
+
+
+def test_feature_fraction_changes_trees():
+    X, y = _xy()
+    ds = lgb.Dataset(X, label=y)
+    full = lgb.train({"objective": "binary", "num_leaves": 7,
+                      "verbosity": -1}, ds, num_boost_round=5)
+    ds2 = lgb.Dataset(X, label=y)
+    frac = lgb.train({"objective": "binary", "num_leaves": 7,
+                      "feature_fraction": 0.3, "verbosity": -1},
+                     ds2, num_boost_round=5)
+    assert not np.allclose(full.predict(X), frac.predict(X))
+
+
+def test_feature_fraction_bynode():
+    X, y = _xy()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "feature_fraction_bynode": 0.4, "verbosity": -1},
+                    ds, num_boost_round=15)
+    acc = np.mean((bst.predict(X) > 0.5) == y)
+    assert acc > 0.88, acc
+
+
+def test_interaction_constraints_respected():
+    X, y = _xy()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "interaction_constraints": "[0,1],[2,3]",
+                     "verbosity": -1}, ds, num_boost_round=15)
+    # every tree's feature set must be inside one constraint group
+    dumped = bst.dump_model()
+    for tree in dumped["tree_info"]:
+        feats = set()
+
+        def walk(node):
+            if "split_feature" in node:
+                feats.add(node["split_feature"])
+                walk(node["left_child"])
+                walk(node["right_child"])
+
+        walk(tree["tree_structure"])
+        assert feats <= {0, 1} or feats <= {2, 3}, feats
+
+
+def test_feature_fraction_distributed():
+    X, y = _xy()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "tree_learner": "data", "feature_fraction": 0.5,
+                     "verbosity": -1}, ds, num_boost_round=8)
+    acc = np.mean((bst.predict(X) > 0.5) == y)
+    assert acc > 0.85, acc
