@@ -1,6 +1,8 @@
 """Training-throughput benchmark vs the reference's HIGGS baseline.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "platform",
+"device", ...} — ALWAYS, even when the device backend is down (structured
+failure record instead of a traceback).
 
 Reference anchor (BASELINE.md): LightGBM CPU trains HIGGS — 10.5M rows x 28
 features, 500 iterations, 255 leaves — in 130.094 s (docs/Experiments.rst:113),
@@ -9,31 +11,88 @@ cannot be downloaded in this sandbox (zero egress), so the bench trains on a
 synthetic dataset with the HIGGS shape profile (28 dense numerical features,
 binary labels, max_bin=255, num_leaves=255) and reports the same
 row-iterations/second measure; vs_baseline = ours / 40.36e6 (>1 is faster).
+
+Resilience: the TPU backend arrives via a tunnel that has failed twice at
+round-end capture (BENCH_r01/r02: backend init + remote-compile connection
+refused), so before building any data we probe the backend in a SUBPROCESS
+with retry/backoff — a probe crash cannot poison this process's JAX — and
+fall back to the CPU backend (clearly labelled) if the TPU never comes up.
+OOM on device falls back to smaller row counts.
 """
 import json
 import os
+import subprocess
+import sys
 import time
-
-import numpy as np
 
 N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 N_FEATURES = 28
 N_ITERS = int(os.environ.get("BENCH_ITERS", 20))
 WARMUP_ITERS = 2
 BASELINE_ROW_ITERS_PER_SEC = 10_500_000 * 500 / 130.094
+PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", 4))
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
+
+_PROBE_SRC = (
+    "import jax, json; d = jax.devices()[0]; "
+    "x = (jax.numpy.ones(()) + 1).block_until_ready(); "
+    "print(json.dumps({'platform': d.platform, 'device': str(d)}))"
+)
 
 
-def main() -> None:
-    import lightgbm_tpu as lgb
+def emit(record: dict) -> None:
+    sys.stdout.flush()
+    print(json.dumps(record), flush=True)
+
+
+def probe_backend() -> dict:
+    """Probe the default JAX backend in a subprocess with retry/backoff.
+
+    Returns {"platform", "device"}; falls back to the CPU backend (and says
+    so) when the accelerator tunnel never answers.
+    """
+    forced = os.environ.get("BENCH_PLATFORM")
+    if forced:
+        return {"platform": forced, "device": f"forced:{forced}",
+                "fallback": forced == "cpu"}
+    last_err = ""
+    for attempt in range(PROBE_RETRIES):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S)
+            if out.returncode == 0 and out.stdout.strip():
+                info = json.loads(out.stdout.strip().splitlines()[-1])
+                info["fallback"] = False
+                return info
+            last_err = (out.stderr or out.stdout).strip()[-400:]
+        except subprocess.TimeoutExpired:
+            last_err = f"probe timeout after {PROBE_TIMEOUT_S}s"
+        except Exception as e:  # noqa: BLE001 - structured failure record
+            last_err = repr(e)
+        if attempt + 1 < PROBE_RETRIES:
+            time.sleep(min(5 * 2 ** attempt, 30))
+    return {"platform": "cpu", "device": "cpu (accelerator probe failed)",
+            "fallback": True, "probe_error": last_err}
+
+
+def make_data(n_rows: int):
+    import numpy as np
 
     rng = np.random.RandomState(42)
-    X = rng.randn(N_ROWS, N_FEATURES).astype(np.float32)
+    X = rng.randn(n_rows, N_FEATURES).astype(np.float32)
     w = rng.randn(N_FEATURES)
     logit = X[:5_000_000] @ w  # cap the label-gen matmul cost
-    if N_ROWS > logit.shape[0]:
+    if n_rows > logit.shape[0]:
         logit = np.concatenate([logit, X[5_000_000:] @ w])
-    y = (logit + rng.randn(N_ROWS).astype(np.float32) > 0).astype(np.float64)
+    y = (logit + rng.randn(n_rows).astype(np.float32) > 0).astype(np.float64)
+    return X, y
 
+
+def run_bench(n_rows: int) -> dict:
+    import lightgbm_tpu as lgb
+
+    X, y = make_data(n_rows)
     params = {
         "objective": "binary",
         "num_leaves": 255,
@@ -50,14 +109,58 @@ def main() -> None:
     for _ in range(N_ITERS):
         bst.update()
     elapsed = time.perf_counter() - t0
+    rips = n_rows * N_ITERS / elapsed
+    return {"row_iters_per_sec": rips, "elapsed_s": elapsed, "rows": n_rows,
+            "iters": N_ITERS}
 
-    row_iters_per_sec = N_ROWS * N_ITERS / elapsed
-    print(json.dumps({
+
+def main() -> None:
+    info = probe_backend()
+    if info.get("fallback"):
+        # the accelerator never answered: run on CPU so the record still
+        # carries a real (if incomparable) number + the structured reason
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 - best-effort override
+            pass
+
+    record = {
         "metric": "train_row_iters_per_sec",
-        "value": round(row_iters_per_sec, 1),
+        "value": 0.0,
         "unit": "row_iters/s",
-        "vs_baseline": round(row_iters_per_sec / BASELINE_ROW_ITERS_PER_SEC, 4),
-    }))
+        "vs_baseline": 0.0,
+        "platform": info.get("platform"),
+        "device": info.get("device"),
+        "tpu_fallback_to_cpu": bool(info.get("fallback")),
+    }
+    if info.get("probe_error"):
+        record["probe_error"] = info["probe_error"]
+
+    n_rows = N_ROWS
+    last_err = ""
+    min_rows = min(50_000, N_ROWS)
+    while n_rows >= min_rows:
+        try:
+            res = run_bench(n_rows)
+            record["value"] = round(res["row_iters_per_sec"], 1)
+            record["vs_baseline"] = round(
+                res["row_iters_per_sec"] / BASELINE_ROW_ITERS_PER_SEC, 4)
+            record["elapsed_s"] = round(res["elapsed_s"], 3)
+            record["rows"] = res["rows"]
+            record["iters"] = res["iters"]
+            emit(record)
+            return
+        except Exception as e:  # noqa: BLE001 - degrade, don't crash
+            last_err = repr(e)[:400]
+            oom = "RESOURCE_EXHAUSTED" in last_err or "Out of memory" in last_err
+            n_rows //= 4
+            if not oom and n_rows < N_ROWS // 16:
+                break  # non-OOM failures get a few shrink retries, then stop
+    record["error"] = last_err or "exhausted row-count fallbacks"
+    emit(record)
 
 
 if __name__ == "__main__":

@@ -77,6 +77,11 @@ class Dataset:
         label = self.label
         feature_names: Optional[List[str]] = None
 
+        if isinstance(data, (str, Path)) and _is_binary_cache(str(data)):
+            self._handle = _CoreDataset.load_binary(str(data), config)
+            self._raw = self._handle._loaded_raw
+            return self
+
         if isinstance(data, (str, Path)):
             X, y, names = parse_file(
                 str(data), header=config.header,
@@ -219,17 +224,41 @@ class Dataset:
         return self._handle.num_total_features
 
     def save_binary(self, filename: str) -> "Dataset":
-        """Binary dataset cache (Dataset::SaveBinaryFile analog, npz-based)."""
+        """Binary dataset cache (Dataset::SaveBinaryFile analog, npz-based);
+        `Dataset(filename)` loads it back, skipping parse + bin-finding."""
         self.construct()
         h = self._handle
-        np.savez_compressed(
-            filename, bins=h.bins,
-            label=h.metadata.label if h.metadata.label is not None else [],
-            mappers=json.dumps([m.to_dict() for m in h.mappers]),
-            feature_names=json.dumps(h.feature_names),
-            group_lists=json.dumps([g.feature_indices for g in h.groups]),
-            raw=self._raw if self._raw is not None else [])
+        md = h.metadata
+        with open(filename, "wb") as fh:  # file object: numpy must not
+            np.savez_compressed(  # append .npz to the requested name
+                fh, bins=h.bins,
+                label=md.label if md.label is not None else [],
+                weight=md.weights if md.weights is not None else [],
+                init_score=md.init_score if md.init_score is not None else [],
+                query_boundaries=(md.query_boundaries
+                                  if md.query_boundaries is not None else []),
+                positions=md.positions if md.positions is not None else [],
+                position_ids=(md.position_ids
+                              if md.position_ids is not None else []),
+                mappers=json.dumps([m.to_dict() for m in h.mappers]),
+                feature_names=json.dumps(h.feature_names),
+                group_lists=json.dumps(
+                    [g.feature_indices for g in h.groups]),
+                group_is_multi=json.dumps([g.is_multi for g in h.groups]),
+                used_features=json.dumps(h.used_features),
+                num_total_features=h.num_total_features,
+                monotone=json.dumps(h.monotone_constraints),
+                raw=self._raw if self._raw is not None else [])
         return self
+
+
+def _is_binary_cache(path: str) -> bool:
+    """A save_binary cache is an npz (zip) file: check the PK magic."""
+    try:
+        with open(path, "rb") as fh:
+            return fh.read(2) == b"PK"
+    except OSError:
+        return False
 
 
 class Booster:
@@ -307,6 +336,23 @@ class Booster:
         grad = np.asarray(grad, dtype=np.float32)
         hess = np.asarray(hess, dtype=np.float32)
         return self._gbdt.train_one_iter(grad, hess)
+
+    def refit(self, data, label, decay_rate: float = 0.9,
+              **kwargs: Any) -> "Booster":
+        """Refit the existing tree structures on new data
+        (python-package Booster.refit / LGBM_BoosterRefit)."""
+        data = np.asarray(data, dtype=np.float64)
+        pred_leaf = self.predict(data, pred_leaf=True)
+        new_params = {**self.params, "refit_decay_rate": decay_rate}
+        train_set = Dataset(data, label=label, **kwargs)
+        new_booster = Booster(new_params, train_set)
+        new_booster._gbdt.models = GBDTModel.from_string(
+            self.model_to_string()).trees
+        new_booster._gbdt.iter_ = (len(new_booster._gbdt.models)
+                                   // new_booster._gbdt.num_tree_per_iteration)
+        new_booster._gbdt.refit(
+            np.asarray(pred_leaf, dtype=np.int32).reshape(data.shape[0], -1))
+        return new_booster
 
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()

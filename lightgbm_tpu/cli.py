@@ -54,7 +54,7 @@ def run(argv: List[str]) -> int:
     if task == "convert_model":
         return _task_convert(config, params)
     if task == "refit":
-        Log.fatal("Task refit is not yet supported in the TPU CLI")
+        return _task_refit(config, params)
     if task == "save_binary":
         ds = Dataset(config.data, params=params)
         ds.construct()
@@ -76,13 +76,45 @@ def _task_train(config: Config, params: Dict[str, str]) -> int:
         valid_sets.append(Dataset(vp, reference=train_ds, params=params))
         valid_names.append(f"valid_{i + 1}")
     callbacks = [callback_mod.log_evaluation(period=max(config.metric_freq, 1))]
+    out = config.output_model or "LightGBM_model.txt"
+    if config.snapshot_freq > 0:
+        freq = config.snapshot_freq
+
+        def _snapshot(env) -> None:
+            # gbdt.cpp:258-262: periodic model checkpoints during training
+            if (env.iteration + 1) % freq == 0:
+                env.model.save_model(f"{out}.snapshot_iter_{env.iteration + 1}")
+
+        _snapshot.order = 30
+        callbacks.append(_snapshot)
     booster = train_fn(params, train_ds, num_boost_round=config.num_iterations,
                        valid_sets=valid_sets or None,
                        valid_names=valid_names or None,
                        callbacks=callbacks)
-    out = config.output_model or "LightGBM_model.txt"
     booster.save_model(out)
     Log.info("Finished training, model saved to %s", out)
+    return 0
+
+
+def _task_refit(config: Config, params: Dict[str, str]) -> int:
+    """Application refit task (application.cpp:229-268): predict leaf
+    indices of the input model on the refit data, then RefitTree."""
+    if not config.input_model:
+        Log.fatal("No input model, please set input_model=...")
+    if not config.data:
+        Log.fatal("No refit data, please set data=...")
+    from .io.parser import (load_query_boundaries, load_weights, parse_file)
+
+    old = Booster(model_file=config.input_model, params=params)
+    X, y, _ = parse_file(config.data, header=config.header,
+                         label_column=config.label_column or "0")
+    new_booster = old.refit(X, y, decay_rate=config.refit_decay_rate,
+                            weight=load_weights(config.data),
+                            group=load_query_boundaries(config.data),
+                            params=params)
+    out = config.output_model or "LightGBM_model.txt"
+    new_booster.save_model(out)
+    Log.info("Finished RefitTree, model saved to %s", out)
     return 0
 
 

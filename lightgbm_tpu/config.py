@@ -269,6 +269,15 @@ class Config:
         # set num_leaves explicitly (config.cpp CheckParamConflict)
         if self.max_depth > 0 and "num_leaves" not in self.raw_params:
             self.num_leaves = min(self.num_leaves, (1 << self.max_depth))
+        # linear-tree constraints (config.cpp:425-440)
+        if self.linear_tree:
+            if self.tree_learner != "serial":
+                Log.warning("Linear tree learner must be serial.")
+                self.tree_learner = "serial"
+            if self.zero_as_missing:
+                Log.fatal("zero_as_missing must be false when fitting linear trees.")
+            if self.objective == "regression_l1":
+                Log.fatal("Cannot use regression_l1 objective when fitting linear trees.")
 
     def to_string(self) -> str:
         """Model-file `parameters:` section — Config::SaveMembersToString format.

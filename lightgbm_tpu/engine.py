@@ -77,14 +77,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
             booster.add_valid(valid_data, name)
 
     cbs = set(callbacks or [])
+    verbosity = int(params.get("verbosity", 1))  # CLI conf values arrive as str
     if params.get("early_stopping_round") and int(params["early_stopping_round"]) > 0:
         cbs.add(callback_mod.early_stopping(int(params["early_stopping_round"]),
                                             first_metric_only,
-                                            verbose=bool(params.get("verbosity", 1) >= 1)))
-    if params.get("verbosity", 1) >= 1 and not any(
-            getattr(cb, "order", 0) == 10 and not getattr(cb, "before_iteration", False)
-            for cb in cbs):
-        pass  # reference does not auto-add log_evaluation; users opt in
+                                            verbose=verbosity >= 1))
     callbacks_before = sorted((cb for cb in cbs if getattr(cb, "before_iteration", False)),
                               key=lambda cb: getattr(cb, "order", 0))
     callbacks_after = sorted((cb for cb in cbs if not getattr(cb, "before_iteration", False)),

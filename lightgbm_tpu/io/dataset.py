@@ -232,7 +232,19 @@ class Dataset:
         sample_nonzero_masks: List[np.ndarray] = []
         sample_idx = (rng.choice(n, sample_cnt, replace=False)
                       if n > sample_cnt else np.arange(n))
-        forced_bounds = ()  # forcedbins_filename support arrives with the loader
+        # forcedbins_filename (DatasetLoader::GetForcedBins,
+        # src/io/dataset_loader.cpp): JSON [{"feature", "bin_upper_bound"}]
+        forced_by_feature: Dict[int, List[float]] = {}
+        if config.forcedbins_filename:
+            import json as _json
+
+            try:
+                with open(config.forcedbins_filename) as fh:
+                    for entry in _json.load(fh):
+                        forced_by_feature[int(entry["feature"])] = [
+                            float(v) for v in entry["bin_upper_bound"]]
+            except OSError:
+                Log.warning("Could not open %s", config.forcedbins_filename)
         for j in range(f):
             col = data[sample_idx, j]
             nonzero = col[(col != 0) | np.isnan(col)]
@@ -248,7 +260,7 @@ class Dataset:
                             bin_type=bt,
                             use_missing=config.use_missing,
                             zero_as_missing=config.zero_as_missing,
-                            forced_upper_bounds=forced_bounds)
+                            forced_upper_bounds=forced_by_feature.get(j, ()))
             self.mappers.append(mapper)
             sample_nonzero_masks.append((col != 0) & ~np.isnan(col))
 
@@ -292,6 +304,52 @@ class Dataset:
                     # push order semantics)
                     acc = np.where(gb != 0, gb, acc)
                 self.bins[gi] = acc.astype(dtype)
+
+    @classmethod
+    def load_binary(cls, path: str,
+                    config: Optional[Config] = None) -> "Dataset":
+        """Rebuild a constructed Dataset from a save_binary npz cache
+        (DatasetLoader::LoadFromBinFile analog): bins, mappers, groups, and
+        metadata restore directly — no re-parse, no bin finding."""
+        import json as _json
+
+        from .binning import BinMapper
+
+        z = np.load(path, allow_pickle=False)
+        self = cls(config)
+        self.bins = z["bins"]
+        self.num_data = int(self.bins.shape[1])
+        self.num_total_features = int(z["num_total_features"])
+        self.mappers = [BinMapper.from_dict(d)
+                        for d in _json.loads(str(z["mappers"]))]
+        self.feature_names = _json.loads(str(z["feature_names"]))
+        self.used_features = _json.loads(str(z["used_features"]))
+        self.monotone_constraints = _json.loads(str(z["monotone"]))
+        group_lists = _json.loads(str(z["group_lists"]))
+        group_multi = _json.loads(str(z["group_is_multi"]))
+        self.groups = []
+        self.feature_to_group = {}
+        for gi, (feats, multi) in enumerate(zip(group_lists, group_multi)):
+            fg = FeatureGroup(feats, [self.mappers[f] for f in feats], multi)
+            self.groups.append(fg)
+            for mi, f in enumerate(feats):
+                self.feature_to_group[f] = (gi, mi)
+        self.metadata = Metadata(self.num_data)
+        if z["label"].size:
+            self.metadata.set_label(z["label"])
+        if z["weight"].size:
+            self.metadata.set_weights(z["weight"])
+        if z["init_score"].size:
+            self.metadata.set_init_score(z["init_score"])
+        if z["query_boundaries"].size:
+            qb = np.asarray(z["query_boundaries"], dtype=np.int32)
+            self.metadata.query_boundaries = qb
+        if z["positions"].size:
+            self.metadata.positions = np.asarray(z["positions"], np.int32)
+            self.metadata.position_ids = z["position_ids"]
+        raw = z["raw"]
+        self._loaded_raw = raw if raw.size else None  # single npz read
+        return self
 
     def _align_with(self, reference: "Dataset", data: np.ndarray) -> None:
         self._reference = reference

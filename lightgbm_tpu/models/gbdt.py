@@ -190,6 +190,18 @@ class GBDT:
                     new_tree = self.tree_learner.train(gh_ext, bag)
             if new_tree.num_leaves > 1:
                 should_continue = True
+                if self.config.linear_tree:
+                    from ..treelearner.linear import fit_leaf_linear_models
+
+                    gvec = grads[c] if C > 1 else grads
+                    hvec = hesses[c] if C > 1 else hesses
+                    with global_timer.scope("linear_fit"):
+                        fit_leaf_linear_models(
+                            new_tree, self.train_set, self.train_raw,
+                            self.tree_learner.partition,
+                            np.asarray(gvec), np.asarray(hvec),
+                            self.config.linear_lambda,
+                            is_first_tree=len(self.models) < C)
                 if self.objective is not None:
                     self.objective.renew_tree_output(
                         new_tree, self.score[c], self.tree_learner.partition)
@@ -251,6 +263,12 @@ class GBDT:
         """Add an arbitrary (e.g. previously trained) tree's outputs to the
         train score of every row via bin-space traversal — the train-time
         ScoreUpdater::AddScore(tree) path DART/RF renormalization needs."""
+        if tree.is_linear:
+            packed = pack_ensemble([tree], fixed_leaves=self.config.num_leaves,
+                                   fixed_depth=self._depth_bound)
+            delta = predict_raw(packed, self._train_raw_dev())[:, 0]
+            self.score = self.score.at[class_id].add(delta)
+            return
         score = add_tree_to_score(
             tree, self.train_set, self.tree_learner.bins_dev,
             self.score[class_id], self._all_rows_padded(), self.num_data,
@@ -263,7 +281,22 @@ class GBDT:
         for vd in self.valid_sets:
             vd.score = vd.score.at[class_id].multiply(val)
 
+    def _train_raw_dev(self) -> jax.Array:
+        if getattr(self, "_train_raw_dev_cache", None) is None:
+            self._train_raw_dev_cache = jnp.asarray(self.train_raw,
+                                                    dtype=jnp.float32)
+        return self._train_raw_dev_cache
+
     def _update_train_score(self, tree: Tree, class_id: int) -> None:
+        if tree.is_linear:
+            # linear leaves need raw feature values, not leaf constants:
+            # score through the packed linear predictor (AddPredictionToScore
+            # with is_linear, gbdt.cpp)
+            packed = pack_ensemble([tree], fixed_leaves=self.config.num_leaves,
+                                   fixed_depth=self._depth_bound)
+            delta = predict_raw(packed, self._train_raw_dev())[:, 0]
+            self.score = self.score.at[class_id].add(delta)
+            return
         part = self.tree_learner.partition
         score = self.score[class_id]
         ids_fn = getattr(part, "leaf_ids_dev", None)
@@ -348,6 +381,51 @@ class GBDT:
         return np.asarray(predict_leaf_indices(packed, jnp.asarray(X, dtype=jnp.float32)))
 
     # ------------------------------------------------------------------ model
+
+    def refit(self, pred_leaf: np.ndarray) -> None:
+        """GBDT::RefitTree (gbdt.cpp:266-305): keep every tree's structure,
+        refit the leaf outputs on the current training data. pred_leaf is
+        [num_data, num_trees] leaf assignments of the OLD model on the new
+        data; gradients are recomputed per iteration from the accumulating
+        refit score, and each leaf output becomes
+
+            refit_decay_rate * old + (1 - refit_decay_rate) * fit * shrinkage
+
+        (SerialTreeLearner::FitByExistingTree, serial_tree_learner.cpp:250-283
+        — per-leaf sums here are one device scatter-add per tree).
+        """
+        C = self.num_tree_per_iteration
+        T = len(self.models)
+        if pred_leaf.shape != (self.num_data, T):
+            Log.fatal("Refit leaf predictions shape %s != (%d, %d)",
+                      pred_leaf.shape, self.num_data, T)
+        decay = self.config.refit_decay_rate
+        cfg = self.config
+        leaf_dev = jnp.asarray(pred_leaf.astype(np.int32))
+        for it in range(T // C):
+            grads, hesses = self._grad_fn(
+                self.score if C > 1 else self.score[0])
+            for c in range(C):
+                m = it * C + c
+                tree = self.models[m]
+                g = grads[c] if C > 1 else grads
+                h = hesses[c] if C > 1 else hesses
+                leaf = leaf_dev[:, m]
+                L = tree.num_leaves
+                sum_g = np.asarray(jnp.zeros(L).at[leaf].add(g))
+                sum_h = np.asarray(jnp.zeros(L).at[leaf].add(h))
+                from ..treelearner.serial import _leaf_output_host
+
+                for i in range(L):
+                    out = _leaf_output_host(
+                        float(sum_g[i]), float(sum_h[i]) + K_EPSILON,
+                        cfg.lambda_l1, cfg.lambda_l2, cfg.max_delta_step)
+                    tree.set_leaf_output(
+                        i, decay * float(tree.leaf_value[i])
+                        + (1.0 - decay) * out * tree.shrinkage)
+                lv = jnp.asarray(tree.leaf_value[:L], dtype=jnp.float32)
+                self.score = self.score.at[c].add(lv[leaf])
+        self._packed_cache = None
 
     def rollback_one_iter(self) -> None:
         """RollbackOneIter (gbdt.cpp:462): drop the last iteration's trees and

@@ -189,6 +189,8 @@ class Tree:
     def add_bias(self, val: float) -> None:
         self.leaf_value[: self.num_leaves] += val
         self.internal_value[: max(self.num_leaves - 1, 0)] += val
+        if self.is_linear and self.leaf_const is not None:
+            self.leaf_const[: self.num_leaves] += val
         self.shrinkage = 1.0
 
     def as_constant_tree(self, val: float) -> None:

@@ -117,6 +117,29 @@ class LambdarankNDCG(ObjectiveFunction):
         self._w = (jnp.asarray(metadata.weights) if metadata.weights is not None else None)
         self._gain_dev = jnp.asarray(self.label_gain, dtype=jnp.float32)
         self._fns = {}
+        # position debias state (rank_objective.hpp:43-90, 296-340): per-
+        # position-id bias factors, Newton-updated from the lambdas each
+        # iteration; gradients are computed on bias-adjusted scores
+        self._positions = None
+        if metadata.positions is not None:
+            self._positions = jnp.asarray(metadata.positions)
+            P = len(metadata.position_ids)
+            self._num_positions = P
+            self._pos_biases = jnp.zeros(P, dtype=jnp.float32)
+            self._pos_counts = jnp.zeros(P, jnp.float32).at[self._positions].add(1.0)
+            self._bias_reg = jnp.float32(
+                self.config.lambdarank_position_bias_regularization)
+            self._bias_lr = jnp.float32(self.config.learning_rate)
+
+            @jax.jit
+            def _update_biases(biases, grad, hess, positions, counts):
+                fd = -(jnp.zeros_like(biases).at[positions].add(grad))
+                sd = -(jnp.zeros_like(biases).at[positions].add(hess))
+                fd = fd - biases * self._bias_reg * counts
+                sd = sd - self._bias_reg * counts
+                return biases + self._bias_lr * fd / (jnp.abs(sd) + 0.001)
+
+            self._update_biases = _update_biases
 
     def _bucket_fn(self, L: int):
         if L in self._fns:
@@ -184,6 +207,10 @@ class LambdarankNDCG(ObjectiveFunction):
 
     def get_gradients(self, score):
         n = self.num_data
+        if self._positions is not None:
+            # lambdas come from bias-adjusted scores; the model score itself
+            # is untouched (rank_objective.hpp:66-74 score_adjusted)
+            score = score + self._pos_biases[self._positions]
         score_ext = jnp.concatenate([score, jnp.zeros(1, score.dtype)])
         grad = jnp.zeros(n, dtype=jnp.float32)
         hess = jnp.zeros(n, dtype=jnp.float32)
@@ -196,6 +223,10 @@ class LambdarankNDCG(ObjectiveFunction):
         if self._w is not None:
             grad = grad * self._w
             hess = hess * self._w
+        if self._positions is not None:
+            self._pos_biases = self._update_biases(
+                self._pos_biases, grad, hess, self._positions,
+                self._pos_counts)
         return grad, hess
 
     def to_string(self):

@@ -32,6 +32,14 @@ import jax.numpy as jnp
 DEFAULT_ROW_CHUNK = 16384
 
 
+def _acc_dtype(compute_dtype):
+    """Accumulator dtype: int32 for integer (quantized-gradient) histograms
+    — exact, and int8 x int8 -> int32 contractions are MXU-native — f32
+    otherwise."""
+    return (jnp.int32 if jnp.issubdtype(jnp.dtype(compute_dtype), jnp.integer)
+            else jnp.float32)
+
+
 def _hist_chunk(bins_c: jax.Array, gh_c: jax.Array, num_bins: int,
                 compute_dtype) -> jax.Array:
     """One chunk: bins_c [G, C] int32, gh_c [C, 3] -> [G, num_bins, 3]."""
@@ -40,7 +48,7 @@ def _hist_chunk(bins_c: jax.Array, gh_c: jax.Array, num_bins: int,
     return jax.lax.dot_general(
         onehot, gh_c.astype(compute_dtype),
         dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=_acc_dtype(compute_dtype),
     )  # [G, B, 3]
 
 
@@ -70,7 +78,7 @@ def build_histogram(bins: jax.Array, gh: jax.Array, num_bins: int,
         b_c, g_c = xs
         return acc + _hist_chunk(b_c, g_c, num_bins, compute_dtype), None
 
-    init = jnp.zeros((G, num_bins, gh.shape[1]), dtype=jnp.float32)
+    init = jnp.zeros((G, num_bins, gh.shape[1]), dtype=_acc_dtype(compute_dtype))
     hist, _ = jax.lax.scan(step, init, (bins_s, gh_s))
     return hist
 
@@ -107,7 +115,8 @@ def build_histogram_rows(bins: jax.Array, gh_ext: jax.Array, row_idx: jax.Array,
         b_c, g_c = xs
         return acc + _hist_chunk(b_c, g_c, num_bins, compute_dtype), None
 
-    init = jnp.zeros((G, num_bins, gh_leaf.shape[1]), dtype=jnp.float32)
+    init = jnp.zeros((G, num_bins, gh_leaf.shape[1]),
+                     dtype=_acc_dtype(compute_dtype))
     hist, _ = jax.lax.scan(step, init, (bins_s, gh_s))
     return hist
 

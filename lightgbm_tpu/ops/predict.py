@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +45,11 @@ class PackedEnsemble:
     num_leaves: jax.Array  # [T] int32
     max_depth: int
     num_trees: int
+    # linear-tree per-leaf models (tree.h leaf_const_/leaf_coeff_/leaf_features_)
+    linear: bool = False  # static: gates the linear output path
+    lin_const: Optional[jax.Array] = None  # [T, L] (leaf_value for non-linear trees)
+    lin_feat: Optional[jax.Array] = None  # [T, L, K] int32, -1 padding
+    lin_coeff: Optional[jax.Array] = None  # [T, L, K]
 
     def tree_slice(self, start: int, end: int) -> "PackedEnsemble":
         return PackedEnsemble(
@@ -60,6 +65,10 @@ class PackedEnsemble:
             num_leaves=self.num_leaves[start:end],
             max_depth=self.max_depth,
             num_trees=end - start,
+            linear=self.linear,
+            lin_const=self.lin_const[start:end] if self.linear else None,
+            lin_feat=self.lin_feat[start:end] if self.linear else None,
+            lin_coeff=self.lin_coeff[start:end] if self.linear else None,
         )
 
 
@@ -67,8 +76,11 @@ jax.tree_util.register_pytree_node(
     PackedEnsemble,
     lambda p: ((p.split_feature, p.threshold, p.decision_type, p.left_child,
                 p.right_child, p.leaf_value, p.cat_words, p.cat_offset,
-                p.cat_n_words, p.num_leaves), (p.max_depth, p.num_trees)),
-    lambda aux, ch: PackedEnsemble(*ch, max_depth=aux[0], num_trees=aux[1]),
+                p.cat_n_words, p.num_leaves, p.lin_const, p.lin_feat,
+                p.lin_coeff), (p.max_depth, p.num_trees, p.linear)),
+    lambda aux, ch: PackedEnsemble(
+        *ch[:10], max_depth=aux[0], num_trees=aux[1], linear=aux[2],
+        lin_const=ch[10], lin_feat=ch[11], lin_coeff=ch[12]),
 )
 
 
@@ -113,6 +125,23 @@ def pack_ensemble(trees: Sequence[Tree], dtype=jnp.float32,
                     cw_n[k, node] = hi - lo
                     cat_words.extend(tree.cat_threshold[lo:hi])
         lv[k, : tree.num_leaves] = tree.leaf_value[: tree.num_leaves]
+    any_linear = any(t.is_linear for t in trees)
+    lin_const = lin_feat = lin_coeff = None
+    if any_linear:
+        K = max((len(t.leaf_features[i]) for t in trees if t.is_linear
+                 for i in range(t.num_leaves)), default=0)
+        lin_const = lv.copy()  # non-linear trees fall through to leaf_value
+        lin_feat = np.full((T, L, K), -1, dtype=np.int32)
+        lin_coeff = np.zeros((T, L, K), dtype=np.float64)
+        for k, tree in enumerate(trees):
+            if not tree.is_linear or tree.leaf_const is None:
+                continue
+            lin_const[k, : tree.num_leaves] = tree.leaf_const[: tree.num_leaves]
+            for i in range(tree.num_leaves):
+                nf = len(tree.leaf_features[i])
+                if nf:
+                    lin_feat[k, i, :nf] = tree.leaf_features[i]
+                    lin_coeff[k, i, :nf] = tree.leaf_coeff[i]
     if not cat_words:
         cat_words = [0]
     # float64 thresholds only take effect with jax x64 enabled; otherwise
@@ -140,6 +169,10 @@ def pack_ensemble(trees: Sequence[Tree], dtype=jnp.float32,
         num_leaves=jnp.asarray(nl),
         max_depth=max(int(max_depth), fixed_depth),
         num_trees=len(trees),
+        linear=any_linear,
+        lin_const=jnp.asarray(lin_const, dtype=dtype) if any_linear else None,
+        lin_feat=jnp.asarray(lin_feat) if any_linear else None,
+        lin_coeff=jnp.asarray(lin_coeff, dtype=dtype) if any_linear else None,
     )
 
 
@@ -202,7 +235,21 @@ def predict_raw(packed: PackedEnsemble, X: jax.Array, num_tree_per_iteration: in
 
     def tree_score(k):
         leaf = _tree_leaf_index(packed, k, X, packed.max_depth)
-        return packed.leaf_value[k][leaf]
+        base = packed.leaf_value[k][leaf]
+        if not packed.linear:
+            return base
+        # linear leaf output: const + coeffs . raw features, falling back to
+        # the constant leaf value when any model feature is NaN/inf
+        # (Tree::PredictByMap linear path, src/io/tree.cpp)
+        feats = packed.lin_feat[k][leaf]  # [N, K]
+        used = feats >= 0
+        fv = jnp.take_along_axis(
+            X, jnp.clip(feats, 0, X.shape[1] - 1), axis=1)
+        bad = (used & ~jnp.isfinite(fv)).any(axis=1)
+        fv = jnp.where(used, fv, 0.0)
+        lin = packed.lin_const[k][leaf] + jnp.where(
+            used, packed.lin_coeff[k][leaf] * fv, 0.0).sum(axis=1)
+        return jnp.where(bad, base, lin)
 
     scores = jax.vmap(tree_score)(jnp.arange(T))  # [T, N]
     scores = scores.reshape(T // num_tree_per_iteration, num_tree_per_iteration, X.shape[0])

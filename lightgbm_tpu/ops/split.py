@@ -53,7 +53,6 @@ class FeatureMeta:
     nbins: jax.Array  # [F] int32 bins per feature
     is_categorical: jax.Array  # [F] bool
     monotone: jax.Array  # [F] int32 (-1/0/+1)
-    penalty: jax.Array  # [F] float32 per-feature split gain penalty (CEGB lazy)
     # host-side
     real_feature: List[int]  # dense idx -> original feature index
     max_bins: int
@@ -63,7 +62,7 @@ class FeatureMeta:
     def tree_flatten(self):
         return ((self.gather_index, self.valid_slot, self.default_bin,
                  self.efb_omitted, self.missing_type, self.nbins,
-                 self.is_categorical, self.monotone, self.penalty),
+                 self.is_categorical, self.monotone),
                 (self.real_feature, self.max_bins, self.hist_rows,
                  self.has_categorical))
 
@@ -94,7 +93,6 @@ def make_feature_meta(dataset, group_bin_padded: int) -> FeatureMeta:
     nbins = np.zeros(F, dtype=np.int32)
     is_cat = np.zeros(F, dtype=bool)
     mono = np.zeros(F, dtype=np.int32)
-    penalty = np.zeros(F, dtype=np.float32)
     G = dataset.num_groups
     sentinel = G * group_bin_padded  # flat index of the all-zero sentinel row
     for k, f in enumerate(feats):
@@ -133,7 +131,6 @@ def make_feature_meta(dataset, group_bin_padded: int) -> FeatureMeta:
         nbins=jnp.asarray(nbins),
         is_categorical=jnp.asarray(is_cat),
         monotone=jnp.asarray(mono),
-        penalty=jnp.asarray(penalty),
         real_feature=list(feats),
         max_bins=Bmax,
         hist_rows=G * group_bin_padded,
@@ -150,11 +147,12 @@ class ScanMeta(NamedTuple):
     missing_type: jax.Array  # [F] int32
     nbins: jax.Array  # [F] int32
     is_categorical: jax.Array  # [F] bool
+    monotone: jax.Array  # [F] int32 (-1/0/+1)
 
 
 def scan_meta_of(meta: FeatureMeta) -> ScanMeta:
     return ScanMeta(meta.valid_slot, meta.default_bin, meta.missing_type,
-                    meta.nbins, meta.is_categorical)
+                    meta.nbins, meta.is_categorical, meta.monotone)
 
 
 def pad_feature_meta(meta: FeatureMeta, f_pad: int) -> FeatureMeta:
@@ -176,7 +174,6 @@ def pad_feature_meta(meta: FeatureMeta, f_pad: int) -> FeatureMeta:
         nbins=jnp.concatenate([meta.nbins, jnp.ones(pad, jnp.int32)]),
         is_categorical=jnp.concatenate([meta.is_categorical, jnp.zeros(pad, bool)]),
         monotone=jnp.concatenate([meta.monotone, jnp.zeros(pad, jnp.int32)]),
-        penalty=jnp.concatenate([meta.penalty, jnp.zeros(pad, jnp.float32)]),
         real_feature=list(meta.real_feature) + [-1] * pad,
         max_bins=meta.max_bins,
         hist_rows=meta.hist_rows,
@@ -264,21 +261,32 @@ def gather_feature_hist(hist: jax.Array, meta: FeatureMeta,
     fh = flat[meta.gather_index]  # [F, Bmax, 3]
     fh = fh * meta.valid_slot[:, :, None]
     # EFB default-bin reconstruction: default = leaf totals - sum(other bins)
-    missing_mass = totals[None, :] - fh.sum(axis=1)  # [F, 3]
-    add = jnp.where(meta.efb_omitted[:, None], missing_mass, 0.0)
+    # (dtype-preserving multiply, not jnp.where with a float 0: quantized
+    # histograms flow through here as exact int32)
+    missing_mass = totals[None, :].astype(fh.dtype) - fh.sum(axis=1)  # [F, 3]
+    add = missing_mass * meta.efb_omitted[:, None]
     fh = fh.at[jnp.arange(fh.shape[0]), meta.default_bin].add(add)
     return fh
 
 
 def per_feature_best(fh: jax.Array, totals: jax.Array, meta: FeatureMeta,
                      params: jax.Array,
-                     feature_mask: Optional[jax.Array] = None) -> jax.Array:
+                     feature_mask: Optional[jax.Array] = None,
+                     constraint: Optional[jax.Array] = None,
+                     penalty: Optional[jax.Array] = None) -> jax.Array:
     """Best split per feature: [F, len(SPLIT_FIELDS)] records.
 
     fh:     [F, Bmax, 3] feature histograms (after gather_feature_hist)
     totals: [3] leaf (sum_grad, sum_hess, count)
     params: [lambda_l1, lambda_l2, min_data_in_leaf, min_sum_hessian_in_leaf,
              min_gain_to_split, max_delta_step] as a device vector
+    constraint: optional [2] (min, max) leaf output bounds — basic-mode
+             monotone constraints (monotone_constraints.hpp BasicLeafConstraints):
+             candidate outputs are clamped, and splits on a monotone feature
+             whose clamped outputs violate the direction are discarded
+             (GetSplitGains, feature_histogram.hpp:788-792).
+    penalty: optional [F] gain penalty subtracted per feature (CEGB DeltaGain,
+             cost_effective_gradient_boosting.hpp:80-98).
 
     The `feature` field is the LOCAL row index into fh (invalid rows get -1);
     distributed feature shards offset it by their block start. This is the
@@ -324,8 +332,18 @@ def per_feature_best(fh: jax.Array, totals: jax.Array, meta: FeatureMeta,
             ok &= feature_mask[:, None]
         if lane == 1:
             ok &= has_missing[:, None]
-        gain = (leaf_gain(lg, lh, l1, l2, max_delta)
-                + leaf_gain(rg, rh, l1, l2, max_delta))
+        if constraint is not None:
+            lo_ = leaf_output(lg, lh, l1, l2, max_delta)
+            ro_ = leaf_output(rg, rh, l1, l2, max_delta)
+            lo_ = jnp.clip(lo_, constraint[0], constraint[1])
+            ro_ = jnp.clip(ro_, constraint[0], constraint[1])
+            mono = meta.monotone[:, None]
+            ok &= ~(((mono > 0) & (lo_ > ro_)) | ((mono < 0) & (lo_ < ro_)))
+            gain = (leaf_gain_given_output(lg, lh, l1, l2, lo_)
+                    + leaf_gain_given_output(rg, rh, l1, l2, ro_))
+        else:
+            gain = (leaf_gain(lg, lh, l1, l2, max_delta)
+                    + leaf_gain(rg, rh, l1, l2, max_delta))
         gain = jnp.where(ok, gain, -jnp.inf)
         results.append((gain, lg, lh, lc, rg, rh, rc))
 
@@ -350,8 +368,13 @@ def per_feature_best(fh: jax.Array, totals: jax.Array, meta: FeatureMeta,
 
     is_valid = jnp.isfinite(best_gain) & (best_gain > gain_shift)
     out_gain = jnp.where(is_valid, best_gain - gain_shift, -jnp.inf)
+    if penalty is not None:
+        out_gain = jnp.where(is_valid, out_gain - penalty, -jnp.inf)
     lout = leaf_output(lg, lh, l1, l2, max_delta)
     rout = leaf_output(rg, rh, l1, l2, max_delta)
+    if constraint is not None:
+        lout = jnp.clip(lout, constraint[0], constraint[1])
+        rout = jnp.clip(rout, constraint[0], constraint[1])
     zeros = jnp.zeros_like(out_gain)
     # default_left lane semantics: lane 1 sends the missing bin left
     return jnp.stack([
@@ -365,7 +388,9 @@ def per_feature_best(fh: jax.Array, totals: jax.Array, meta: FeatureMeta,
 
 def per_feature_best_categorical(fh: jax.Array, totals: jax.Array,
                                  meta: FeatureMeta, params: jax.Array,
-                                 feature_mask: Optional[jax.Array] = None
+                                 feature_mask: Optional[jax.Array] = None,
+                                 constraint: Optional[jax.Array] = None,
+                                 penalty: Optional[jax.Array] = None
                                  ) -> jax.Array:
     """Best categorical split per feature: [F, len(SPLIT_FIELDS)] records.
 
@@ -487,8 +512,13 @@ def per_feature_best_categorical(fh: jax.Array, totals: jax.Array,
     if feature_mask is not None:
         is_valid &= feature_mask
     out_gain = jnp.where(is_valid, best_gain - gain_shift, neg_inf)
+    if penalty is not None:
+        out_gain = jnp.where(is_valid, out_gain - penalty, neg_inf)
     lout = leaf_output(lg, lh, l1, l2_eff, max_delta)
     rout = leaf_output(rg, rh, l1, l2_eff, max_delta)
+    if constraint is not None:
+        lout = jnp.clip(lout, constraint[0], constraint[1])
+        rout = jnp.clip(rout, constraint[0], constraint[1])
     return jnp.stack([
         out_gain,
         jnp.where(is_valid, rows.astype(jnp.float32), -1.0),
@@ -543,18 +573,24 @@ def reduce_best_record(recs: jax.Array) -> jax.Array:
 @partial(jax.jit, static_argnames=())
 def find_best_split(hist: jax.Array, totals: jax.Array, meta: FeatureMeta,
                     params: jax.Array,
-                    feature_mask: Optional[jax.Array] = None) -> jax.Array:
+                    feature_mask: Optional[jax.Array] = None,
+                    constraint: Optional[jax.Array] = None,
+                    penalty: Optional[jax.Array] = None) -> jax.Array:
     """Best split across all features for one leaf.
 
     hist:   [G, Bg, 3] group histogram for the leaf
     totals: [3] leaf (sum_grad, sum_hess, count)
     feature_mask: optional [F] bool (ColSampler / interaction constraints)
+    constraint: optional [2] (min, max) output bounds (monotone constraints)
+    penalty: optional [F] per-feature gain penalty (CEGB)
     Returns packed split record [len(SPLIT_FIELDS)] float32.
     """
     fh = gather_feature_hist(hist, meta, totals)  # [F, Bmax, 3]
-    recs = per_feature_best(fh, totals, meta, params, feature_mask)
+    recs = per_feature_best(fh, totals, meta, params, feature_mask,
+                            constraint, penalty)
     if meta.has_categorical:  # static flag: skip the scan entirely otherwise
         cat_recs = per_feature_best_categorical(fh, totals, meta, params,
-                                                feature_mask)
+                                                feature_mask, constraint,
+                                                penalty)
         recs = jnp.concatenate([recs, cat_recs])
     return reduce_best_record(recs)

@@ -36,11 +36,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..config import Config
 from ..io.dataset import Dataset
 from ..ops.histogram import build_histogram
-from ..ops.partition import split_decision_bins
+from ..ops.partition import split_decision_bins, split_decision_bins_cat
 from ..ops.split import (SplitInfo, gather_feature_hist, pad_feature_meta,
-                         per_feature_best, reduce_best_record, scan_meta_of)
+                         per_feature_best, per_feature_best_categorical,
+                         reduce_best_record, scan_meta_of)
 from ..treelearner.serial import SerialTreeLearner, _LeafState
 from ..utils.log import Log
+from .dist import host_value, init_distributed, put_global, put_global_tree
 from .mesh import data_mesh
 
 
@@ -49,13 +51,24 @@ def _ceil_to(n: int, d: int) -> int:
 
 
 
-def _make_feature_scan_fn(mesh, f_local):
-    """jit(shard_map) best-split scan over feature blocks: each device scans
-    its block, offsets local feature indices, all_gathers the packed records
-    and reduces to the global best (SyncUpGlobalBestSplit)."""
+def _better_record(recs: jax.Array, other: jax.Array) -> jax.Array:
+    """Row-wise pick the higher-gain record. Each feature is either numerical
+    or categorical, so exactly one of the two scans can be finite per row."""
+    return jnp.where((other[:, 0] > recs[:, 0])[:, None], other, recs)
 
-    def scan_block(fh_blk, totals, params, scan_meta_sh, mask_sh):
-        recs = per_feature_best(fh_blk, totals, scan_meta_sh, params, mask_sh)
+
+def _make_feature_scan_fn(mesh, f_local, has_cat: bool = False):
+    """jit(shard_map) best-split scan over feature blocks: each device scans
+    its block (numerical + categorical lanes), offsets local feature indices,
+    all_gathers the packed records and reduces to the global best
+    (SyncUpGlobalBestSplit, parallel_tree_learner.h:209)."""
+
+    def scan_block(fh_blk, totals, params, scan_meta_sh, mask_sh, constraint):
+        recs = per_feature_best(fh_blk, totals, scan_meta_sh, params, mask_sh,
+                                constraint)
+        if has_cat:
+            recs = _better_record(recs, per_feature_best_categorical(
+                fh_blk, totals, scan_meta_sh, params, mask_sh, constraint))
         off = (jax.lax.axis_index("data") * f_local).astype(jnp.float32)
         feat = recs[:, 1]
         recs = recs.at[:, 1].set(jnp.where(feat >= 0, feat + off, -1.0))
@@ -64,8 +77,8 @@ def _make_feature_scan_fn(mesh, f_local):
 
     return jax.jit(jax.shard_map(
         scan_block, mesh=mesh,
-        in_specs=(P("data"), P(), P(), P("data"), P("data")), out_specs=P(),
-        check_vma=False))
+        in_specs=(P("data"), P(), P(), P("data"), P("data"), P()),
+        out_specs=P(), check_vma=False))
 
 
 class LeafIdPartition:
@@ -108,8 +121,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
         self.f_pad = _ceil_to(max(F, self.D), self.D)
         self.f_local = self.f_pad // self.D
         self.meta_pad = pad_feature_meta(self.meta, self.f_pad)
-        self.scan_meta_sharded = jax.device_put(
-            scan_meta_of(self.meta_pad), NamedSharding(self.mesh, P("data")))
+        self.scan_meta_sharded = put_global_tree(
+            scan_meta_of(self.meta_pad), self.mesh, P("data"))
         self._row_valid = np.zeros(self.n_pad, dtype=bool)
         self._row_valid[: self.num_data] = True
         self.leaf_id: Optional[jax.Array] = None
@@ -123,46 +136,65 @@ class DataParallelTreeLearner(SerialTreeLearner):
         load of DatasetLoader::LoadFromFile(rank, num_machines))."""
         bins_pad = np.pad(dataset.bins,
                           ((0, 0), (0, self.n_pad - dataset.num_data)))
-        return jax.device_put(bins_pad,
-                              NamedSharding(self.mesh, P(None, "data")))
+        return put_global(bins_pad, self.mesh, P(None, "data"))
 
     def _build_step_fns(self) -> None:
         mesh = self.mesh
         bpad = self.group_bin_padded
         f_local = self.f_local
+        qz = self.quantized
+        cd = jnp.int8 if qz else jnp.float32
 
-        def fh_block(bins_sh, gh_sh, leaf_id_sh, leaf, meta_full):
-            """Local masked histogram -> locally-gathered feature hists ->
-            psum_scatter so each device owns an aggregated feature block."""
-            mask = leaf_id_sh == leaf
-            ghm = jnp.where(mask[:, None], gh_sh, 0.0)
-            hist = build_histogram(bins_sh, ghm, bpad)  # [G, Bpad, 3] local
-            local_tot = hist[0].sum(axis=0)
-            # EFB FixHistogram runs on local totals: the reconstruction is
-            # linear in (hist, totals) so it commutes with the reduction
-            fh = gather_feature_hist(hist, meta_full, local_tot)
-            return jax.lax.psum_scatter(fh, "data", scatter_dimension=0,
-                                        tiled=True)
+        def make_fh_block(narrow: bool):
+            def fh_block(bins_sh, gh_sh, leaf_id_sh, leaf, meta_full):
+                """Local masked histogram -> locally-gathered feature hists ->
+                psum_scatter so each device owns an aggregated feature block.
+                `narrow` reduces quantized int32 histograms in int16 (half the
+                ICI bytes — the int16 reduction of
+                data_parallel_tree_learner.cpp:285-297), chosen per leaf when
+                leaf_count * num_grad_quant_bins provably fits."""
+                mask = leaf_id_sh == leaf
+                ghm = jnp.where(mask[:, None], gh_sh,
+                                jnp.zeros((), gh_sh.dtype))
+                hist = build_histogram(bins_sh, ghm, bpad, compute_dtype=cd)
+                local_tot = hist[0].sum(axis=0)
+                # EFB FixHistogram runs on local totals: the reconstruction is
+                # linear in (hist, totals) so it commutes with the reduction
+                fh = gather_feature_hist(hist, meta_full, local_tot)
+                if narrow:
+                    fh = fh.astype(jnp.int16)
+                red = jax.lax.psum_scatter(fh, "data", scatter_dimension=0,
+                                           tiled=True)
+                return red.astype(jnp.int32) if narrow else red
 
-        self._fh_block_fn = jax.jit(jax.shard_map(
-            fh_block, mesh=mesh,
-            in_specs=(P(None, "data"), P("data"), P("data"), P(), P()),
-            out_specs=P("data")))
+            return jax.jit(jax.shard_map(
+                fh_block, mesh=mesh,
+                in_specs=(P(None, "data"), P("data"), P("data"), P(), P()),
+                out_specs=P("data")))
 
-        self._scan_fn = _make_feature_scan_fn(mesh, f_local)
+        self._fh_block_fn = make_fh_block(False)
+        self._fh_block_fn_i16 = make_fh_block(True) if qz else None
+
+        self._scan_fn = _make_feature_scan_fn(
+            mesh, f_local, self.meta.has_categorical)
 
         def totals_fn(gh_sh, leaf_id_sh):
             mask = leaf_id_sh == 0
-            return jax.lax.psum(
-                jnp.where(mask[:, None], gh_sh, 0.0).sum(axis=0), "data")
+            vals = jnp.where(mask[:, None], gh_sh, jnp.zeros((), gh_sh.dtype))
+            if qz:
+                vals = vals.astype(jnp.int32)
+            return jax.lax.psum(vals.sum(axis=0), "data")
 
         self._totals_fn = jax.jit(jax.shard_map(
             totals_fn, mesh=mesh,
             in_specs=(P("data"), P("data")), out_specs=P()))
 
-        def partition_fn(bins_sh, leaf_id_sh, decision, gi, leaf, new_leaf):
+        def partition_fn(bins_sh, leaf_id_sh, decision, gi, leaf, new_leaf,
+                         cat_mask, use_cat):
             gb = jnp.take(bins_sh, gi, axis=0)
-            go_left = split_decision_bins(gb, decision)
+            go_left = jnp.where(use_cat,
+                                split_decision_bins_cat(gb, decision, cat_mask),
+                                split_decision_bins(gb, decision))
             on_leaf = leaf_id_sh == leaf
             new_ids = jnp.where(on_leaf & go_left, leaf,
                                 jnp.where(on_leaf, new_leaf, leaf_id_sh))
@@ -171,7 +203,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
 
         self._partition_fn = jax.jit(jax.shard_map(
             partition_fn, mesh=mesh,
-            in_specs=(P(None, "data"), P("data"), P(), P(), P(), P()),
+            in_specs=(P(None, "data"), P("data"), P(), P(), P(), P(), P(),
+                      P()),
             out_specs=(P("data"), P())))
 
     # ------------------------------------------------------------------ hooks
@@ -179,16 +212,17 @@ class DataParallelTreeLearner(SerialTreeLearner):
     def _begin_tree(self, gh_ext: jax.Array,
                     bag_indices: Optional[np.ndarray]) -> None:
         n, npad = self.num_data, self.n_pad
+        gh_ext = self._prepare_gh(gh_ext)
         gh = jnp.concatenate(
             [gh_ext[:n], jnp.zeros((npad - n, gh_ext.shape[1]), gh_ext.dtype)])
-        self._gh_sh = jax.device_put(gh, NamedSharding(self.mesh, P("data")))
+        self._gh_sh = put_global(gh, self.mesh, P("data"))
         in_bag = self._row_valid
         if bag_indices is not None:
             in_bag = np.zeros(npad, dtype=bool)
             in_bag[np.asarray(bag_indices, dtype=np.int64)] = True
             in_bag &= self._row_valid
         ids = np.where(in_bag, 0, -1).astype(np.int32)
-        self.leaf_id = jax.device_put(ids, NamedSharding(self.mesh, P("data")))
+        self.leaf_id = put_global(ids, self.mesh, P("data"))
         self.partition = LeafIdPartition(self)
         self.partition.counts[0] = int(in_bag.sum())
         # tree-level column sampling (per-node masks would need a transfer
@@ -197,40 +231,67 @@ class DataParallelTreeLearner(SerialTreeLearner):
         mask = np.ones(self.f_pad, dtype=bool)
         if self.col_sampler.active:
             mask[:F] = self.col_sampler.reset_by_tree()
-        self._mask_padded = jax.device_put(
-            mask, NamedSharding(self.mesh, P("data")))
+        self._mask_padded = put_global(mask, self.mesh, P("data"))
 
     def _leaf_hist(self, leaf: int) -> jax.Array:
-        return self._fh_block_fn(self.bins_dev, self._gh_sh, self.leaf_id,
-                                 jnp.int32(leaf), self.meta_pad)
+        fn = self._fh_block_fn
+        if self.quantized and self._int16_reduction_safe(leaf):
+            fn = self._fh_block_fn_i16
+        return fn(self.bins_dev, self._gh_sh, self.leaf_id,
+                  jnp.int32(leaf), self.meta_pad)
+
+    def _int16_reduction_safe(self, leaf: int) -> bool:
+        """All channel sums (and every ring partial sum) of a leaf's integer
+        histogram are bounded by leaf_count * num_grad_quant_bins."""
+        count = self.partition.counts.get(leaf, self.num_data)
+        return count * self.config.num_grad_quant_bins < 32000
 
     def _root_totals(self, root_hist) -> Tuple[float, float, float]:
-        tot = np.asarray(self._totals_fn(self._gh_sh, self.leaf_id))
+        tot = host_value(self._totals_fn(self._gh_sh, self.leaf_id))
+        if self.quantized:
+            s = np.asarray(self._scale_vec)
+            return (float(tot[0]) * float(s[0]),
+                    float(tot[1]) * float(s[1]), float(tot[2]))
         return (float(tot[0]), float(tot[1]), float(tot[2]))
 
-    def _search_split(self, state: _LeafState) -> SplitInfo:
-        rec = self._scan_fn(state.hist,
+    def _search_split(self, state: _LeafState, leaf: int) -> SplitInfo:
+        rec = self._scan_fn(self._hist_for_scan(state.hist),
                             jnp.asarray(state.totals, dtype=jnp.float32),
                             self.params_dev, self.scan_meta_sharded,
-                            self._mask_padded)
-        return SplitInfo.from_packed(np.asarray(rec))
+                            self._mask_padded, self._constraint_dev(state))
+        return SplitInfo.from_packed(host_value(rec))
+
+    def _constraint_dev(self, state: _LeafState) -> jax.Array:
+        return jnp.asarray(state.bounds, dtype=jnp.float32)
 
     def _partition_split(self, leaf: int, new_leaf: int, gi: int,
                          decision: jax.Array,
                          cat_mask=None) -> Tuple[int, int]:
-        # categorical splits are masked out of the distributed scans for now
-        # (per_feature_best's ok &= ~is_categorical), so cat_mask never flows
-        assert cat_mask is None
+        use_cat = cat_mask is not None
+        if cat_mask is None:  # static-shape placeholder for the jitted fn
+            cat_mask = jnp.zeros(self.group_bin_padded, dtype=bool)
         new_ids, left_dev = self._partition_fn(
             self.bins_dev, self.leaf_id, decision, jnp.int32(gi),
-            jnp.int32(leaf), jnp.int32(new_leaf))
+            jnp.int32(leaf), jnp.int32(new_leaf), cat_mask,
+            jnp.bool_(use_cat))
         self.leaf_id = new_ids
-        left = int(left_dev)
+        left = int(host_value(left_dev))
         parent = self.partition.counts[leaf]
         self.partition.counts[leaf] = left
         self.partition.counts[new_leaf] = parent - left
         self.partition.invalidate()
         return left, parent - left
+
+    def _cat_bin_stats(self, state: _LeafState, gi: int,
+                       dense_f: int) -> np.ndarray:
+        # state.hist is the psum_scatter'd FEATURE-major [f_pad, Bmax, 3]
+        # block array; each row is already globally aggregated
+        return host_value(self._hist_for_scan(state.hist)[dense_f])
+
+    def _feature_hist_row(self, state: _LeafState,
+                          dense_f: int) -> np.ndarray:
+        # feature-major layout: the row IS the aggregated feature histogram
+        return host_value(self._hist_for_scan(state.hist)[dense_f])
 
 
 class FeatureParallelTreeLearner(SerialTreeLearner):
@@ -244,9 +305,10 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         self.f_pad = _ceil_to(max(F, self.D), self.D)
         self.f_local = self.f_pad // self.D
         self.meta_pad = pad_feature_meta(self.meta, self.f_pad)
-        self.scan_meta_sharded = jax.device_put(
-            scan_meta_of(self.meta_pad), NamedSharding(self.mesh, P("data")))
-        self._scan_fn = _make_feature_scan_fn(self.mesh, self.f_local)
+        self.scan_meta_sharded = put_global_tree(
+            scan_meta_of(self.meta_pad), self.mesh, P("data"))
+        self._scan_fn = _make_feature_scan_fn(self.mesh, self.f_local,
+                                              self.meta.has_categorical)
         self._gather_fn = jax.jit(gather_feature_hist)
 
     def _begin_tree(self, gh_ext, bag_indices) -> None:
@@ -255,15 +317,16 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         mask = np.ones(self.f_pad, dtype=bool)
         if self._tree_feature_mask is not None:
             mask[:F] = np.asarray(self._tree_feature_mask)
-        self._mask_padded = jax.device_put(
-            mask, NamedSharding(self.mesh, P("data")))
+        self._mask_padded = put_global(mask, self.mesh, P("data"))
 
-    def _search_split(self, state: _LeafState) -> SplitInfo:
+    def _search_split(self, state: _LeafState, leaf: int) -> SplitInfo:
         totals = jnp.asarray(state.totals, dtype=jnp.float32)
-        fh = self._gather_fn(state.hist, self.meta_pad, totals)
+        fh = self._gather_fn(self._hist_for_scan(state.hist), self.meta_pad,
+                             totals)
         rec = self._scan_fn(fh, totals, self.params_dev,
-                            self.scan_meta_sharded, self._mask_padded)
-        return SplitInfo.from_packed(np.asarray(rec))
+                            self.scan_meta_sharded, self._mask_padded,
+                            jnp.asarray(state.bounds, dtype=jnp.float32))
+        return SplitInfo.from_packed(host_value(rec))
 
 
 class VotingParallelTreeLearner(DataParallelTreeLearner):
@@ -298,13 +361,21 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             in_specs=(P(None, "data"), P("data"), P("data"), P()),
             out_specs=P("data")))
 
+        has_cat = self.meta.has_categorical
+
         def vote_scan(local_hist_blk, totals, params, meta_full,
-                      scan_meta_full, mask_full):
+                      scan_meta_full, mask_full, constraint):
             lh = local_hist_blk[0]  # this device's [G, Bpad, 3]
             local_tot = lh[0].sum(axis=0)
             fh_local = gather_feature_hist(lh, meta_full, local_tot)
             local_recs = per_feature_best(fh_local, local_tot,
-                                          scan_meta_full, params, mask_full)
+                                          scan_meta_full, params, mask_full,
+                                          constraint)
+            if has_cat:
+                local_recs = _better_record(
+                    local_recs, per_feature_best_categorical(
+                        fh_local, local_tot, scan_meta_full, params,
+                        mask_full, constraint))
             # phase 1: local proposal of top-k features by local gain
             _, topk_idx = jax.lax.top_k(local_recs[:, 0], k_local)
             votes = jax.lax.all_gather(topk_idx, "data", tiled=True)
@@ -315,7 +386,11 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             sel_fh = jax.lax.psum(fh_local[selected], "data")  # [K, Bmax, 3]
             sel_meta = jax.tree_util.tree_map(
                 lambda a: a[selected], scan_meta_full)
-            recs = per_feature_best(sel_fh, totals, sel_meta, params)
+            recs = per_feature_best(sel_fh, totals, sel_meta, params,
+                                    None, constraint)
+            if has_cat:
+                recs = _better_record(recs, per_feature_best_categorical(
+                    sel_fh, totals, sel_meta, params, None, constraint))
             valid = recs[:, 1] >= 0
             recs = recs.at[:, 1].set(
                 jnp.where(valid, selected.astype(jnp.float32), -1.0))
@@ -323,14 +398,29 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
 
         self._vote_scan_fn = jax.jit(jax.shard_map(
             vote_scan, mesh=mesh,
-            in_specs=(P("data"), P(), P(), P(), P(), P()), out_specs=P(),
+            in_specs=(P("data"), P(), P(), P(), P(), P(), P()), out_specs=P(),
             check_vma=False))
 
     def _leaf_hist(self, leaf: int) -> jax.Array:
         return self._local_hist_fn(self.bins_dev, self._gh_sh, self.leaf_id,
                                    jnp.int32(leaf))
 
-    def _search_split(self, state: _LeafState) -> SplitInfo:
+    def _cat_bin_stats(self, state: _LeafState, gi: int,
+                       dense_f: int) -> np.ndarray:
+        # state.hist is the per-device local-hist stack [D, G, Bpad, 3];
+        # sum over the device axis to aggregate the winning feature's row
+        return host_value(self._hist_for_scan(state.hist.sum(axis=0))[gi])
+
+    def _feature_hist_row(self, state: _LeafState,
+                          dense_f: int) -> np.ndarray:
+        from ..ops.split import gather_feature_hist
+
+        agg = self._hist_for_scan(state.hist.sum(axis=0))  # [G, Bpad, 3]
+        fh = gather_feature_hist(agg, self.meta_pad,
+                                 jnp.asarray(state.totals, jnp.float32))
+        return host_value(fh[dense_f])
+
+    def _search_split(self, state: _LeafState, leaf: int) -> SplitInfo:
         mask_full = jnp.ones(self.f_pad, dtype=bool)
         if self.col_sampler.active:
             mask_full = mask_full.at[: len(self.meta.real_feature)].set(
@@ -338,12 +428,24 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
         rec = self._vote_scan_fn(state.hist,
                                  jnp.asarray(state.totals, dtype=jnp.float32),
                                  self.params_dev, self.meta_pad,
-                                 self.scan_meta_full, mask_full)
-        return SplitInfo.from_packed(np.asarray(rec))
+                                 self.scan_meta_full, mask_full,
+                                 jnp.asarray(state.bounds, dtype=jnp.float32))
+        return SplitInfo.from_packed(host_value(rec))
 
 
 def create_parallel_learner(learner_type: str, config: Config,
                             dataset: Dataset):
+    from ..treelearner.cegb import CEGB
+
+    # join the multi-host world first when a machine list / env is present,
+    # so the mesh below spans every process's devices
+    init_distributed(config)
+    if CEGB.enabled(config):
+        Log.fatal("cegb_* parameters are not supported with distributed "
+                  "tree learners (use tree_learner=serial)")
+    if config.use_quantized_grad and learner_type == "voting":
+        Log.fatal("use_quantized_grad is not supported with "
+                  "tree_learner=voting (use data or feature)")
     if learner_type == "data":
         return DataParallelTreeLearner(config, dataset)
     if learner_type == "feature":

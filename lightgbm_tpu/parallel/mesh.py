@@ -11,6 +11,8 @@ from __future__ import annotations
 import jax
 import numpy as np
 
+from ..utils.log import Log
+
 
 def data_mesh(num_machines: int = 0) -> jax.sharding.Mesh:
     """1-D mesh over the row-sharding axis ``data``.
@@ -18,9 +20,28 @@ def data_mesh(num_machines: int = 0) -> jax.sharding.Mesh:
     num_machines <= 1 means "use every visible device" (the reference's
     num_machines=1 is non-distributed; on TPU a single host already exposes
     the full slice, so defaulting to all cores is the native analog).
+
+    Under `jax.distributed` (multi-host), the mesh always spans every
+    process's devices; num_machines is validated against the process count.
+    In a single process, num_machines > 1 selects a sub-mesh of that many
+    devices when available (local simulation of a num_machines cluster) and
+    falls back to all devices with a warning otherwise.
     """
     devices = jax.devices()
     n = len(devices)
     if num_machines and num_machines > 1:
-        n = min(num_machines, n)
+        if jax.process_count() > 1:
+            if num_machines != jax.process_count():
+                Log.warning(
+                    "num_machines=%d does not match the distributed world "
+                    "(%d processes); the mesh uses all %d devices",
+                    num_machines, jax.process_count(), n)
+        elif num_machines <= n:
+            n = num_machines
+        else:
+            Log.warning(
+                "num_machines=%d exceeds the %d visible devices; using a "
+                "%d-device mesh (start one process per machine with "
+                "jax.distributed for a real multi-host run)",
+                num_machines, n, n)
     return jax.sharding.Mesh(np.array(devices[:n]), ("data",))

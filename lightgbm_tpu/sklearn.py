@@ -147,7 +147,15 @@ class LGBMModel(BaseEstimator):
                 vg = eval_group[i] if eval_group else None
                 vx = np.asarray(vx, dtype=np.float64)
                 vy = np.asarray(vy, dtype=np.float64).ravel()
-                if vy.shape[0] == y.shape[0] and np.array_equal(vx, X):
+
+                def _opt_equal(a, b):
+                    if a is None or b is None:
+                        return a is b
+                    return np.array_equal(np.asarray(a), np.asarray(b))
+
+                if (np.array_equal(vy, y) and np.array_equal(vx, X)
+                        and _opt_equal(vw, sample_weight)
+                        and _opt_equal(vg, group)):
                     valid_sets.append(train_set)
                 else:
                     valid_sets.append(Dataset(

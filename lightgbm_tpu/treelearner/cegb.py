@@ -1,0 +1,89 @@
+"""Cost-Effective Gradient Boosting gain penalties.
+
+Counterpart of CostEfficientGradientBoosting
+(src/treelearner/cost_effective_gradient_boosting.hpp:23-174): per-candidate
+split the gain is reduced by
+
+    cegb_tradeoff * cegb_penalty_split * num_data_in_leaf
+  + cegb_tradeoff * cegb_penalty_feature_coupled[f]   (first use of f only)
+  + cegb_tradeoff * sum_{rows in leaf not yet seen by f} penalty_lazy[f]
+
+The penalty is materialized here as a per-leaf [F] vector fed to the split
+scan (ops/split.py per_feature_best), instead of the reference's per-
+(leaf,feature) SplitInfo cache: when a coupled feature is first used, the
+serial learner simply re-runs the (cached-histogram) scans for the live
+frontier — the refund the reference applies by patching stored SplitInfos.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import Log
+
+
+class CEGB:
+    @staticmethod
+    def enabled(config: Config) -> bool:
+        # the reference's IsEnable also triggers on cegb_tradeoff != 1 alone,
+        # but with every penalty zero that is a pure no-op — only an actual
+        # penalty justifies leaving the fast device paths
+        return (config.cegb_penalty_split > 0.0
+                or bool(config.cegb_penalty_feature_coupled)
+                or bool(config.cegb_penalty_feature_lazy))
+
+    def __init__(self, config: Config, dataset) -> None:
+        self.tradeoff = float(config.cegb_tradeoff)
+        self.penalty_split = float(config.cegb_penalty_split)
+        used: List[int] = dataset.used_features
+        self.F = len(used)
+        self.num_data = dataset.num_data
+
+        def per_used(values: List[float], name: str) -> Optional[np.ndarray]:
+            if not values:
+                return None
+            if len(values) != dataset.num_total_features:
+                Log.fatal("%s should be the same size as feature number.", name)
+            return np.asarray([values[f] for f in used], dtype=np.float64)
+
+        self.coupled = per_used(config.cegb_penalty_feature_coupled,
+                                "cegb_penalty_feature_coupled")
+        self.lazy = per_used(config.cegb_penalty_feature_lazy,
+                             "cegb_penalty_feature_lazy")
+        self.used_in_split = np.zeros(self.F, dtype=bool)
+        # per-(feature, row) "feature already computed for this row" marks
+        self.seen: Optional[np.ndarray] = (
+            np.zeros((self.F, self.num_data), dtype=bool)
+            if self.lazy is not None else None)
+
+    @property
+    def needs_rows(self) -> bool:
+        return self.lazy is not None
+
+    def penalty_vector(self, leaf_count: float,
+                       leaf_rows: Optional[np.ndarray]) -> np.ndarray:
+        """[F] gain penalty for one leaf's split scan (DeltaGain)."""
+        vec = np.full(self.F, self.tradeoff * self.penalty_split * leaf_count,
+                      dtype=np.float64)
+        if self.coupled is not None:
+            vec += np.where(self.used_in_split, 0.0,
+                            self.tradeoff * self.coupled)
+        if self.lazy is not None and leaf_rows is not None and len(leaf_rows):
+            unseen = (~self.seen[:, leaf_rows]).sum(axis=1)  # [F]
+            vec += self.tradeoff * self.lazy * unseen
+        return vec.astype(np.float32)
+
+    def on_split_applied(self, dense_f: int,
+                         leaf_rows: Optional[np.ndarray]) -> bool:
+        """Record a committed split on dense feature dense_f over leaf_rows.
+        Returns True when a coupled penalty was just lifted (the caller must
+        refresh pending frontier scans — UpdateLeafBestSplits)."""
+        newly = (self.coupled is not None
+                 and not self.used_in_split[dense_f]
+                 and self.coupled[dense_f] > 0)
+        self.used_in_split[dense_f] = True
+        if self.seen is not None and leaf_rows is not None:
+            self.seen[dense_f, leaf_rows] = True
+        return bool(newly)

@@ -1,0 +1,108 @@
+"""Per-leaf linear model fitting for linear trees.
+
+Counterpart of LinearTreeLearner::CalculateLinear
+(src/treelearner/linear_tree_learner.cpp:180-392): after a tree is grown,
+each leaf gets a linear model over the NUMERICAL features on its branch
+path, solving the hessian-weighted ridge normal equations of Eq 3 in
+de Vito (arXiv:1802.05640):
+
+    coeffs = -(X^T H X + lambda I)^{-1} X^T g
+
+where X is [rows-in-leaf, k+1] raw feature values with a ones column,
+H = diag(hess), g = grad. Numerical-stability fallbacks mirror the
+reference: rows with NaN in any leaf feature are dropped from the solve;
+leaves with fewer usable rows than k+1 keep their constant output; the
+solve uses a pseudo-inverse (the reference's fullPivLu), and coefficients
+within kZeroThreshold of zero are pruned.
+
+The host solves are tiny (num_leaves × (depth+1)² doubles); the heavy part
+— per-row leaf membership and the X^T H X accumulation — is vectorized
+numpy over each leaf's row set.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..common import K_ZERO_THRESHOLD
+from ..models.tree import Tree
+
+
+def fit_leaf_linear_models(tree: Tree, dataset, raw: np.ndarray,
+                           partition, grad: np.ndarray, hess: np.ndarray,
+                           linear_lambda: float,
+                           is_first_tree: bool) -> None:
+    """Fit per-leaf linear models in place on `tree`.
+
+    raw:  [N, F_total] raw feature matrix (training data)
+    partition: the tree learner's partition (per-leaf row index sets)
+    grad/hess: [N] float gradients/hessians
+    """
+    tree.is_linear = True
+    if tree.leaf_const is None:
+        tree.leaf_const = np.zeros(tree.max_leaves, dtype=np.float64)
+        tree.leaf_coeff = [[] for _ in range(tree.max_leaves)]
+        tree.leaf_features = [[] for _ in range(tree.max_leaves)]
+        tree.leaf_features_inner = [[] for _ in range(tree.max_leaves)]
+
+    n_leaves = tree.num_leaves
+    if is_first_tree:
+        for leaf in range(n_leaves):
+            tree.leaf_const[leaf] = tree.leaf_value[leaf]
+            tree.leaf_coeff[leaf] = []
+            tree.leaf_features[leaf] = []
+            tree.leaf_features_inner[leaf] = []
+        return
+
+    num_data = raw.shape[0]
+    grad = np.asarray(grad, dtype=np.float64)
+    hess = np.asarray(hess, dtype=np.float64)
+
+    for leaf in range(n_leaves):
+        # numerical features on the branch path, sorted + deduped
+        # (linear_tree_learner.cpp:208-232)
+        feats: List[int] = sorted({
+            f for f in (tree.branch_features[leaf]
+                        if tree.track_branch_features else [])
+            if dataset.mappers[f].bin_type == 0})
+        rows = np.asarray(partition.indices(leaf))
+        rows = rows[rows < num_data]
+        tree.leaf_features[leaf] = []
+        tree.leaf_features_inner[leaf] = []
+        tree.leaf_coeff[leaf] = []
+        tree.leaf_const[leaf] = tree.leaf_value[leaf]
+        k = len(feats)
+        if k == 0 or len(rows) == 0:
+            continue
+        Xl = np.asarray(raw[np.ix_(rows, feats)], dtype=np.float64)
+        good = ~np.isnan(Xl).any(axis=1)
+        if int(good.sum()) < k + 1:  # too few usable rows: constant leaf
+            continue
+        Xl = Xl[good]
+        g = grad[rows][good]
+        h = hess[rows][good]
+        A = np.concatenate([Xl, np.ones((Xl.shape[0], 1))], axis=1)
+        XTHX = A.T @ (A * h[:, None])
+        XTHX[np.arange(k), np.arange(k)] += linear_lambda
+        XTg = A.T @ g
+        try:
+            coeffs = -np.linalg.solve(XTHX, XTg)
+            if not np.isfinite(coeffs).all():
+                raise np.linalg.LinAlgError
+        except np.linalg.LinAlgError:
+            coeffs = -np.linalg.pinv(XTHX) @ XTg
+        if not np.isfinite(coeffs).all():
+            continue  # keep the constant leaf
+        kept_feats = []
+        kept_coeffs = []
+        for i, f in enumerate(feats):
+            if abs(coeffs[i]) > K_ZERO_THRESHOLD:
+                kept_feats.append(int(f))
+                kept_coeffs.append(float(coeffs[i]))
+        tree.leaf_features[leaf] = kept_feats
+        tree.leaf_features_inner[leaf] = list(kept_feats)
+        tree.leaf_coeff[leaf] = kept_coeffs
+        tree.leaf_const[leaf] = float(coeffs[k])
+
+

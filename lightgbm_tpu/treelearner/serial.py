@@ -30,9 +30,11 @@ from ..io.dataset import Dataset
 from ..models.tree import Tree
 from ..ops.histogram import build_histogram_rows, subtract_histogram
 from ..ops.partition import RowPartition
+from ..ops.quantize import discretize_gradients
 from ..ops.split import (FeatureMeta, SplitInfo, bins_to_bitset,
                          derive_cat_left_bins, find_best_split,
                          make_feature_meta)
+from .cegb import CEGB
 from .col_sampler import ColSampler
 from ..utils.log import Log
 from ..utils.timer import global_timer
@@ -45,6 +47,9 @@ class _LeafState:
     split: Optional[SplitInfo]
     depth: int
     features_in_path: frozenset = frozenset()  # real indices (interaction constraints)
+    # basic-mode monotone output bounds inherited from ancestors
+    # (monotone_constraints.hpp BasicLeafConstraints)
+    bounds: Tuple[float, float] = (-np.inf, np.inf)
 
 
 class SerialTreeLearner:
@@ -67,6 +72,33 @@ class SerialTreeLearner:
         self.partition: Optional[RowPartition] = None
         self.col_sampler = ColSampler(config, self.meta.real_feature)
         self._tree_feature_mask: Optional[jax.Array] = None
+        self._has_mc = bool(dataset.monotone_constraints
+                            and any(dataset.monotone_constraints))
+        if self._has_mc and config.monotone_constraints_method not in (
+                "basic",):
+            Log.fatal("monotone_constraints_method=%s is not supported "
+                      "(only 'basic')", config.monotone_constraints_method)
+        self.cegb: Optional[CEGB] = (CEGB(config, dataset)
+                                     if CEGB.enabled(config) else None)
+        # quantized-gradient training (GradientDiscretizer analog)
+        self.quantized = bool(config.use_quantized_grad)
+        self._scale_vec: Optional[jax.Array] = None
+        if self.quantized:
+            self._quant_key = jax.random.PRNGKey(
+                int(getattr(config, "data_random_seed", 1)))
+        # forcedsplits_filename (SerialTreeLearner::ForceSplits,
+        # serial_tree_learner.cpp:627+): nested {"feature","threshold",
+        # "left","right"} JSON applied at the top of every tree
+        self._forced_json = None
+        if config.forcedsplits_filename:
+            import json as _json
+
+            try:
+                with open(config.forcedsplits_filename) as fh:
+                    self._forced_json = _json.load(fh)
+            except OSError:
+                Log.warning("Could not open forced splits file %s",
+                            config.forcedsplits_filename)
 
     # ------------------------------------------------------------------ train
 
@@ -76,7 +108,8 @@ class SerialTreeLearner:
         (zero sentinel row at N)."""
         cfg = self.config
         num_leaves = cfg.num_leaves
-        tree = Tree(num_leaves)
+        tree = Tree(num_leaves, track_branch_features=cfg.linear_tree,
+                    is_linear=cfg.linear_tree)
         self._begin_tree(gh_ext, bag_indices)
 
         frontier: Dict[int, _LeafState] = {}
@@ -84,7 +117,8 @@ class SerialTreeLearner:
             root_hist = self._leaf_hist(0)
         root_totals = self._root_totals(root_hist)
         frontier[0] = _LeafState(root_hist, root_totals, None, depth=0)
-        self._find_split(frontier, 0)
+        if not self._force_splits(tree, frontier):
+            self._find_split(frontier, 0)
 
         for _ in range(num_leaves - 1):
             best_leaf, best = None, None
@@ -102,8 +136,30 @@ class SerialTreeLearner:
         # leaf outputs: already set by _apply_split; root-only tree handled
         if tree.num_leaves == 1:
             tree.as_constant_tree(0.0)
+        elif self.quantized and cfg.quant_train_renew_leaf:
+            self._renew_quantized_leaves(tree, frontier)
         self._last_frontier = frontier
         return tree
+
+    def _renew_quantized_leaves(self, tree: Tree,
+                                frontier: Dict[int, _LeafState]) -> None:
+        """Recompute leaf outputs from the TRUE float gradients, removing
+        quantization error (GradientDiscretizer::RenewIntGradTreeOutput,
+        gradient_discretizer.cpp:166-233). Unlike the reference (which renews
+        unclamped), renewed outputs stay inside the leaf's monotone bounds so
+        quantized training keeps the monotonicity guarantee."""
+        cfg = self.config
+        for leaf in range(tree.num_leaves):
+            idx = jnp.asarray(np.asarray(self.partition.indices(leaf)))
+            gh = jnp.take(self._gh_float, idx, axis=0).sum(axis=0)
+            sums = np.asarray(gh)
+            out = _leaf_output_host(float(sums[0]), float(sums[1]),
+                                    cfg.lambda_l1, cfg.lambda_l2,
+                                    cfg.max_delta_step)
+            if self._has_mc and leaf in frontier:
+                lo, hi = frontier[leaf].bounds
+                out = float(np.clip(out, lo, hi))
+            tree.set_leaf_output(leaf, out)
 
     # ------------------------------------------------ device-execution hooks
     # The parallel learners (parallel/learners.py) subclass and override
@@ -112,9 +168,31 @@ class SerialTreeLearner:
     def _device_bins(self, dataset: Dataset) -> jax.Array:
         return jnp.asarray(dataset.bins)
 
+    def _prepare_gh(self, gh_ext: jax.Array) -> jax.Array:
+        """Quantize the gradient pack when use_quantized_grad is on: int8
+        (g, h, 1) rows + a zero sentinel; scales kept for the scan."""
+        if not self.quantized:
+            return gh_ext
+        self._gh_float = gh_ext  # kept for leaf-output renewal
+        self._quant_key, sub = jax.random.split(self._quant_key)
+        g_int, h_int, gs, hs = discretize_gradients(
+            gh_ext[:-1, 0], gh_ext[:-1, 1], sub,
+            self.config.num_grad_quant_bins,
+            self.config.stochastic_rounding)
+        self._scale_vec = jnp.stack([gs, hs, jnp.float32(1.0)])
+        ghq = jnp.stack([g_int, h_int, jnp.ones_like(g_int)], axis=1)
+        return jnp.concatenate([ghq, jnp.zeros((1, 3), jnp.int8)], axis=0)
+
+    def _hist_for_scan(self, hist: jax.Array) -> jax.Array:
+        """Integer histograms re-enter float space via the quantization
+        scales right before the split scan."""
+        if not self.quantized:
+            return hist
+        return hist.astype(jnp.float32) * self._scale_vec
+
     def _begin_tree(self, gh_ext: jax.Array,
                     bag_indices: Optional[np.ndarray]) -> None:
-        self._gh = gh_ext
+        self._gh = self._prepare_gh(gh_ext)
         partition = RowPartition(self.num_data)
         if bag_indices is not None:
             partition.set_used_indices(bag_indices)
@@ -128,11 +206,13 @@ class SerialTreeLearner:
     def _leaf_hist(self, leaf: int) -> jax.Array:
         return build_histogram_rows(
             self.bins_dev, self._gh, self.partition.indices(leaf),
-            self.group_bin_padded)
+            self.group_bin_padded,
+            compute_dtype=jnp.int8 if self.quantized else jnp.float32)
 
     def _root_totals(self, root_hist: jax.Array) -> Tuple[float, float, float]:
         # any group's bins partition all rows, so group 0's bin-sum = totals
-        return tuple(float(x) for x in np.asarray(root_hist[0].sum(axis=0)))
+        return tuple(float(x) for x in np.asarray(
+            self._hist_for_scan(root_hist)[0].sum(axis=0)))
 
     def _node_feature_mask(self, state: "_LeafState") -> Optional[jax.Array]:
         cs = self.col_sampler
@@ -142,11 +222,31 @@ class SerialTreeLearner:
             return jnp.asarray(cs.get_by_node(set(state.features_in_path)))
         return self._tree_feature_mask
 
-    def _search_split(self, state: "_LeafState") -> SplitInfo:
+    def _search_split(self, state: "_LeafState", leaf: int) -> SplitInfo:
         rec = find_best_split(
-            state.hist, jnp.asarray(state.totals, dtype=jnp.float32),
-            self.meta, self.params_dev, self._node_feature_mask(state))
+            self._hist_for_scan(state.hist),
+            jnp.asarray(state.totals, dtype=jnp.float32),
+            self.meta, self.params_dev, self._node_feature_mask(state),
+            self._constraint_of(state), self._penalty_of(state, leaf))
         return SplitInfo.from_packed(np.asarray(rec))
+
+    def _constraint_of(self, state: "_LeafState") -> Optional[jax.Array]:
+        if not self._has_mc:
+            return None
+        return jnp.asarray(state.bounds, dtype=jnp.float32)
+
+    def _penalty_of(self, state: "_LeafState",
+                    leaf: int) -> Optional[jax.Array]:
+        if self.cegb is None:
+            return None
+        rows = self._leaf_rows(leaf) if self.cegb.needs_rows else None
+        return jnp.asarray(
+            self.cegb.penalty_vector(state.totals[2], rows))
+
+    def _leaf_rows(self, leaf: int) -> np.ndarray:
+        """Actual (unpadded) row indices of a leaf, for CEGB lazy tracking."""
+        rows = np.asarray(self.partition.indices(leaf))
+        return rows[rows < self.num_data]
 
     def _partition_split(self, leaf: int, new_leaf: int, gi: int,
                          decision: jax.Array,
@@ -155,10 +255,105 @@ class SerialTreeLearner:
         return self.partition.split(leaf, new_leaf, self.bins_dev[gi],
                                     decision, cat_mask)
 
+    def _cat_bin_stats(self, state: "_LeafState", gi: int,
+                       dense_f: int) -> np.ndarray:
+        """Aggregated histogram row of a winning categorical split's feature
+        (categorical features are never EFB-bundled, so the feature's
+        histogram row IS its group's). Scaled on device so the host bin-set
+        re-derivation replays bit-identical f32 values to the scan."""
+        return np.asarray(self._hist_for_scan(state.hist)[gi])
+
+    def _feature_hist_row(self, state: "_LeafState",
+                          dense_f: int) -> np.ndarray:
+        """One feature's aggregated [Bmax, 3] histogram (forced splits).
+        Overridden by the distributed learners, whose state.hist layouts
+        differ from the serial group-major [G, Bpad, 3]."""
+        from ..ops.split import gather_feature_hist
+
+        return np.asarray(gather_feature_hist(
+            self._hist_for_scan(state.hist), self.meta,
+            jnp.asarray(state.totals, dtype=jnp.float32))[dense_f])
+
     # --------------------------------------------------------------- internal
 
     def _max_depth_ok(self, depth: int) -> bool:
         return self.config.max_depth <= 0 or depth < self.config.max_depth
+
+    def _force_splits(self, tree: Tree, frontier: Dict[int, _LeafState]) -> int:
+        """Apply the forced-splits JSON at the top of the tree
+        (SerialTreeLearner::ForceSplits, serial_tree_learner.cpp:627+).
+        Returns the number of applied splits."""
+        if self._forced_json is None:
+            return 0
+        count = 0
+        queue = [(self._forced_json, 0)]
+        while queue and tree.num_leaves < self.config.num_leaves:
+            jnode, leaf = queue.pop(0)
+            split = self._forced_split_info(frontier[leaf], jnode)
+            if split is None:
+                continue
+            new_leaf = tree.num_leaves
+            self._apply_split(tree, frontier, leaf, split)
+            count += 1
+            if isinstance(jnode.get("left"), dict):
+                queue.append((jnode["left"], leaf))
+            if isinstance(jnode.get("right"), dict):
+                queue.append((jnode["right"], new_leaf))
+        return count
+
+    def _forced_split_info(self, state: "_LeafState",
+                           jnode) -> Optional[SplitInfo]:
+        """Split stats for a forced (feature, threshold) pair, computed from
+        the leaf histogram at the forced bin instead of the best-split scan."""
+        try:
+            real_f = int(jnode["feature"])
+            thr = float(jnode["threshold"])
+        except (KeyError, TypeError, ValueError):
+            return None
+        if real_f not in self.meta.real_feature:
+            return None
+        dense_f = self.meta.real_feature.index(real_f)
+        mapper = self.dataset.mappers[real_f]
+        if mapper.bin_type == 1:  # categorical forced splits unsupported
+            Log.warning("Forced split on categorical feature %d ignored", real_f)
+            return None
+        fh = self._feature_hist_row(state, dense_f)
+        tbin = int(mapper.value_to_bin(thr))
+        nb = mapper.num_bin
+        has_nan = mapper.missing_type == 2
+        # keep at least one real bin right of the threshold; with NaN missing
+        # the last bin is the NaN bin, which clamping also keeps on the right
+        # (default_left=False)
+        if tbin >= nb - (2 if has_nan else 1):
+            tbin = nb - (3 if has_nan else 2)
+        if tbin < 0:
+            return None
+        left = fh[: tbin + 1].sum(axis=0)
+        tg, th_, tc = state.totals
+        lg, lh, lc = float(left[0]), float(left[1]), float(left[2])
+        rg, rh, rc = tg - lg, th_ - lh, tc - lc
+        cfg = self.config
+        if (lc < cfg.min_data_in_leaf or rc < cfg.min_data_in_leaf
+                or lh < cfg.min_sum_hessian_in_leaf
+                or rh < cfg.min_sum_hessian_in_leaf):
+            return None
+        lout = _leaf_output_host(lg, lh, cfg.lambda_l1, cfg.lambda_l2,
+                                 cfg.max_delta_step)
+        rout = _leaf_output_host(rg, rh, cfg.lambda_l1, cfg.lambda_l2,
+                                 cfg.max_delta_step)
+
+        def g(sg, sh, out):
+            sgl = np.sign(sg) * max(abs(sg) - cfg.lambda_l1, 0.0)
+            return -(2.0 * sgl * out + (sh + cfg.lambda_l2) * out * out)
+
+        parent_out = _leaf_output_host(tg, th_, cfg.lambda_l1, cfg.lambda_l2,
+                                       cfg.max_delta_step)
+        gain = g(lg, lh, lout) + g(rg, rh, rout) - g(tg, th_, parent_out)
+        return SplitInfo(gain=float(gain), feature=dense_f, threshold_bin=tbin,
+                         default_left=False, left_sum_g=lg, left_sum_h=lh,
+                         left_count=int(round(lc)), right_sum_g=rg,
+                         right_sum_h=rh, right_count=int(round(rc)),
+                         left_output=lout, right_output=rout)
 
     def _find_split(self, frontier: Dict[int, _LeafState], leaf: int) -> None:
         state = frontier[leaf]
@@ -169,7 +364,7 @@ class SerialTreeLearner:
             state.split = SplitInfo()
             return
         with global_timer.scope("find_best_split"):
-            state.split = self._search_split(state)
+            state.split = self._search_split(state, leaf)
 
     def _apply_split(self, tree: Tree, frontier: Dict[int, _LeafState],
                      leaf: int, split: SplitInfo) -> None:
@@ -192,9 +387,7 @@ class SerialTreeLearner:
             self.config.max_delta_step)
         cat_mask = None
         if split.is_categorical:
-            # categorical features are never EFB-bundled, so the feature's
-            # histogram row IS the group's
-            bin_stats = np.asarray(state.hist[gi])
+            bin_stats = self._cat_bin_stats(state, gi, dense_f)
             left_bins = derive_cat_left_bins(
                 bin_stats, mapper.num_bin, split, self.config.cat_smooth)
             split.cat_bitset_bins = left_bins
@@ -254,13 +447,46 @@ class SerialTreeLearner:
             small_hist = self._leaf_hist(small)
             big_hist = subtract_histogram(parent_hist, small_hist)
         depth = state.depth + 1
+        child_path = state.features_in_path | {int(real_f)}
+        # monotone bound propagation (BasicLeafConstraints::Update,
+        # monotone_constraints.hpp:487-503): a numerical split on a monotone
+        # feature pins the children's shared boundary at the output midpoint
+        lbounds = rbounds = state.bounds
+        if self._has_mc and not split.is_categorical:
+            mono = (self.dataset.monotone_constraints[real_f]
+                    if real_f < len(self.dataset.monotone_constraints) else 0)
+            if mono != 0:
+                lo, hi_b = state.bounds
+                mid = (split.left_output + split.right_output) / 2.0
+                if mono > 0:
+                    lbounds = (lo, min(hi_b, mid))
+                    rbounds = (max(lo, mid), hi_b)
+                else:
+                    lbounds = (max(lo, mid), hi_b)
+                    rbounds = (lo, min(hi_b, mid))
         frontier[leaf] = _LeafState(
-            small_hist if small == leaf else big_hist, left_totals, None, depth)
+            small_hist if small == leaf else big_hist, left_totals, None, depth,
+            child_path, lbounds)
         frontier[new_leaf] = _LeafState(
-            small_hist if small == new_leaf else big_hist, right_totals, None, depth)
+            small_hist if small == new_leaf else big_hist, right_totals, None,
+            depth, child_path, rbounds)
         state.hist = None  # release parent histogram
+        refresh_frontier = False
+        if self.cegb is not None:
+            rows = None
+            if self.cegb.needs_rows:
+                rows = np.concatenate([self._leaf_rows(leaf),
+                                       self._leaf_rows(new_leaf)])
+            refresh_frontier = self.cegb.on_split_applied(dense_f, rows)
         self._find_split(frontier, leaf)
         self._find_split(frontier, new_leaf)
+        if refresh_frontier:
+            # a coupled feature penalty was just lifted: refresh the other
+            # pending scans so their gains drop the stale coupled penalty
+            # (UpdateLeafBestSplits, cost_effective_gradient_boosting.hpp:100)
+            for lf in frontier:
+                if lf not in (leaf, new_leaf):
+                    self._find_split(frontier, lf)
 
 
 def _leaf_output_host(sum_g: float, sum_h: float, l1: float, l2: float,
@@ -290,9 +516,16 @@ def create_tree_learner(learner_type: str, device_type: str, config: Config,
             on_accelerator = False
         has_cat = any(dataset.mappers[f].bin_type == 1
                       for f in dataset.used_features)
-        # per-node feature masks need the host-driven loop for now
+        # per-node feature masks / per-leaf bounds and penalties need the
+        # host-driven loop for now
         needs_host = (config.feature_fraction_bynode < 1.0
-                      or bool(config.interaction_constraints))
+                      or bool(config.interaction_constraints)
+                      or bool(dataset.monotone_constraints
+                              and any(dataset.monotone_constraints))
+                      or CEGB.enabled(config)
+                      or config.linear_tree
+                      or config.use_quantized_grad
+                      or bool(config.forcedsplits_filename))
         if (device_type != "cpu" and on_accelerator and not has_cat
                 and not needs_host
                 and pool_bytes(

@@ -1,0 +1,145 @@
+"""Monotone-constraint and CEGB tests.
+
+References: src/treelearner/monotone_constraints.hpp (BasicLeafConstraints),
+src/treelearner/feature_histogram.hpp:788-792 (constrained GetSplitGains),
+src/treelearner/cost_effective_gradient_boosting.hpp (DeltaGain).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _mono_data(rng, n=3000):
+    X = rng.uniform(-3, 3, size=(n, 3))
+    # y increases in x0, decreases in x1, noisy in x2
+    y = 2.0 * X[:, 0] - 1.5 * X[:, 1] + 0.3 * np.sin(3 * X[:, 2]) \
+        + rng.randn(n) * 0.2
+    return X, y
+
+
+def _sweep_predictions(bst, feature, others, lo=-3, hi=3, k=64):
+    grid = np.linspace(lo, hi, k)
+    X = np.tile(others, (k, 1))
+    X[:, feature] = grid
+    return bst.predict(X)
+
+
+@pytest.mark.parametrize("learner", ["serial", "data"])
+def test_monotone_constraints_enforced(rng, learner):
+    X, y = _mono_data(rng)
+    params = {"objective": "regression", "num_leaves": 31,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "monotone_constraints": [1, -1, 0],
+              "tree_learner": learner, "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=30)
+
+    # predictions must be monotone along the constrained features for many
+    # random slices of the other features
+    for _ in range(20):
+        others = rng.uniform(-3, 3, size=3)
+        up = _sweep_predictions(bst, 0, others)
+        assert np.all(np.diff(up) >= -1e-10), "feature 0 not non-decreasing"
+        down = _sweep_predictions(bst, 1, others)
+        assert np.all(np.diff(down) <= 1e-10), "feature 1 not non-increasing"
+
+    # and the fit should still be useful
+    mse = float(np.mean((bst.predict(X) - y) ** 2))
+    assert mse < np.var(y) * 0.3
+
+
+def test_unconstrained_violates_monotonicity(rng):
+    """Sanity check on the test itself: without constraints the sweep is
+    non-monotone somewhere (otherwise the assertion above proves nothing)."""
+    X, y = _mono_data(rng)
+    params = {"objective": "regression", "num_leaves": 31,
+              "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=30)
+    violated = False
+    for _ in range(20):
+        others = rng.uniform(-3, 3, size=3)
+        up = _sweep_predictions(bst, 0, others)
+        if np.any(np.diff(up) < -1e-10):
+            violated = True
+            break
+    assert violated
+
+
+def test_monotone_constraints_method_fatal(rng):
+    X, y = _mono_data(rng, n=500)
+    params = {"objective": "regression", "num_leaves": 7,
+              "monotone_constraints": [1, 0, 0],
+              "monotone_constraints_method": "advanced", "verbosity": -1}
+    with pytest.raises(Exception):
+        lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=2)
+
+
+def test_cegb_penalty_split_shrinks_trees(rng):
+    X = rng.randn(2000, 5)
+    y = X[:, 0] + 0.5 * X[:, 1] ** 2 + rng.randn(2000) * 0.1
+    base = {"objective": "regression", "num_leaves": 63,
+            "min_data_in_leaf": 20, "verbosity": -1}
+    plain = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=5)
+    pen = lgb.train({**base, "cegb_penalty_split": 2.0},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+
+    def total_leaves(bst):
+        return sum(t["num_leaves"] for t in bst.dump_model()["tree_info"])
+
+    assert total_leaves(pen) < total_leaves(plain)
+
+
+def test_cegb_coupled_feature_penalty(rng):
+    """A huge coupled penalty on every feature but one restricts splits to
+    the free feature."""
+    X = rng.randn(2000, 4)
+    y = X[:, 0] + 0.8 * X[:, 1] + 0.6 * X[:, 2] + rng.randn(2000) * 0.1
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 20, "verbosity": -1,
+              "cegb_penalty_feature_coupled": [1e9, 1e9, 1e9, 0.0]}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3)
+    used = set()
+
+    def walk(node):
+        if "split_feature" in node:
+            used.add(node["split_feature"])
+            walk(node["left_child"])
+            walk(node["right_child"])
+
+    for t in bst.dump_model()["tree_info"]:
+        walk(t["tree_structure"])
+    assert used <= {3}, used
+
+
+def test_cegb_lazy_feature_penalty(rng):
+    """Lazy penalties are charged per not-yet-seen row; once rows are seen
+    by a feature, later splits on it at those rows are cheaper. Just check
+    training works and penalized features are used less."""
+    X = rng.randn(1500, 3)
+    y = 1.0 * X[:, 0] + 0.95 * X[:, 1] + rng.randn(1500) * 0.1
+    base = {"objective": "regression", "num_leaves": 15,
+            "min_data_in_leaf": 20, "verbosity": -1}
+    pen = lgb.train({**base, "cegb_penalty_feature_lazy": [10.0, 0.0, 0.0],
+                     "cegb_tradeoff": 1.0},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    counts = {0: 0, 1: 0, 2: 0}
+
+    def walk(node):
+        if "split_feature" in node:
+            counts[node["split_feature"]] += 1
+            walk(node["left_child"])
+            walk(node["right_child"])
+
+    for t in pen.dump_model()["tree_info"]:
+        walk(t["tree_structure"])
+    assert counts[1] > counts[0]
+
+
+def test_cegb_distributed_fatal(rng):
+    X = rng.randn(500, 3)
+    y = X[:, 0] + rng.randn(500) * 0.1
+    params = {"objective": "regression", "num_leaves": 7,
+              "cegb_penalty_split": 1.0, "tree_learner": "data",
+              "verbosity": -1}
+    with pytest.raises(Exception):
+        lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=2)

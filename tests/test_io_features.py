@@ -1,0 +1,61 @@
+"""Binary dataset cache, forced bins, and forced splits tests
+(Dataset::SaveBinaryFile / DatasetLoader::GetForcedBins /
+SerialTreeLearner::ForceSplits)."""
+import json
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _data(rng, n=1200):
+    X = rng.randn(n, 4)
+    y = (X[:, 0] - 0.5 * X[:, 1] + rng.randn(n) * 0.3 > 0).astype(np.float64)
+    return X, y
+
+
+def test_binary_cache_roundtrip(rng, tmp_path):
+    X, y = _data(rng)
+    ds = lgb.Dataset(X, label=y)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    bst = lgb.train(params, ds, num_boost_round=5)
+    pred = bst.predict(X)
+
+    cache = str(tmp_path / "train.bin")
+    ds.save_binary(cache)
+    ds2 = lgb.Dataset(cache)
+    ds2.construct()
+    np.testing.assert_array_equal(ds2._handle.bins, ds._handle.bins)
+    bst2 = lgb.train(params, ds2, num_boost_round=5)
+    np.testing.assert_allclose(bst2.predict(X), pred, rtol=1e-6)
+
+
+def test_forced_bins(rng, tmp_path):
+    X, y = _data(rng)
+    fb = str(tmp_path / "forced_bins.json")
+    bounds = [-0.5, 0.0, 0.5]
+    with open(fb, "w") as fh:
+        json.dump([{"feature": 0, "bin_upper_bound": bounds}], fh)
+    ds = lgb.Dataset(X, label=y, params={"forcedbins_filename": fb,
+                                         "max_bin": 16})
+    ds.construct()
+    ub = ds._handle.mappers[0].bin_upper_bound
+    for b in bounds:
+        assert any(abs(u - b) < 1e-9 for u in ub), (b, ub)
+
+
+def test_forced_splits(rng, tmp_path):
+    X, y = _data(rng)
+    fs = str(tmp_path / "forced_splits.json")
+    with open(fs, "w") as fh:
+        json.dump({"feature": 2, "threshold": 0.25,
+                   "left": {"feature": 3, "threshold": -0.1}}, fh)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "forcedsplits_filename": fs, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    for t in bst.dump_model()["tree_info"]:
+        root = t["tree_structure"]
+        assert root["split_feature"] == 2
+        assert abs(float(root["threshold"]) - 0.25) < 0.3  # binned threshold
+        assert root["left_child"].get("split_feature") == 3
+    assert np.isfinite(bst.predict(X)).all()

@@ -1,0 +1,67 @@
+"""Linear-tree tests (LinearTreeLearner, src/treelearner/linear_tree_learner.cpp)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _piecewise_linear(rng, n=3000):
+    X = rng.uniform(-2, 2, size=(n, 3))
+    # piecewise-linear target: different slope per region of x0
+    y = np.where(X[:, 0] > 0, 2.0 * X[:, 1] + 1.0, -1.5 * X[:, 1]) \
+        + 0.5 * X[:, 2] + rng.randn(n) * 0.05
+    return X, y
+
+
+def test_linear_tree_beats_constant_leaves(rng):
+    X, y = _piecewise_linear(rng)
+    base = {"objective": "regression", "num_leaves": 7, "learning_rate": 0.2,
+            "min_data_in_leaf": 40, "verbosity": -1}
+    plain = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=20)
+    lin = lgb.train({**base, "linear_tree": True, "linear_lambda": 0.01},
+                    lgb.Dataset(X, label=y), num_boost_round=20)
+    mse_plain = float(np.mean((plain.predict(X) - y) ** 2))
+    mse_lin = float(np.mean((lin.predict(X) - y) ** 2))
+    assert mse_lin < mse_plain * 0.8, (mse_lin, mse_plain)
+
+
+def test_linear_tree_model_roundtrip(rng, tmp_path):
+    X, y = _piecewise_linear(rng, n=1500)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "linear_tree": True, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    pred = bst.predict(X)
+    path = str(tmp_path / "linear.txt")
+    bst.save_model(path)
+    assert "is_linear=1" in open(path).read()
+    re_pred = lgb.Booster(model_file=path).predict(X)
+    np.testing.assert_allclose(re_pred, pred, rtol=1e-5, atol=1e-7)
+
+
+def test_linear_tree_nan_fallback(rng):
+    """Rows with NaN in a leaf-model feature fall back to the constant
+    leaf value (tree.cpp linear prediction path)."""
+    X, y = _piecewise_linear(rng, n=1500)
+    X[::50, 1] = np.nan  # some NaNs in a model feature
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "linear_tree": True, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    pred = bst.predict(X)
+    assert np.isfinite(pred).all()
+
+
+def test_linear_tree_forces_serial(rng):
+    X, y = _piecewise_linear(rng, n=800)
+    params = {"objective": "regression", "num_leaves": 7,
+              "linear_tree": True, "tree_learner": "data", "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=2)
+    assert np.isfinite(bst.predict(X)).all()
+
+
+def test_linear_tree_l1_fatal(rng):
+    import pytest
+
+    X, y = _piecewise_linear(rng, n=500)
+    with pytest.raises(Exception):
+        lgb.train({"objective": "regression_l1", "linear_tree": True,
+                   "verbosity": -1}, lgb.Dataset(X, label=y),
+                  num_boost_round=1)

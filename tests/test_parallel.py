@@ -93,3 +93,40 @@ def test_data_parallel_with_bagging_indices(rng):
     part = learner.partition
     total = sum(part.count(i) for i in range(tree.num_leaves))
     assert total == 700
+
+
+@pytest.mark.parametrize("learner", ["data", "feature", "voting"])
+def test_parallel_categorical_splits(rng, learner):
+    """Distributed learners handle categorical features (the reference's
+    distributed learners do, data_parallel_tree_learner.cpp); data/feature
+    parallel must agree with serial exactly."""
+    n = 2000
+    cats = rng.randint(0, 12, size=n)
+    effect = np.where(np.isin(cats, [2, 5, 7]), 2.0, -1.0)
+    X = np.column_stack([cats.astype(np.float64), rng.randn(n)])
+    y = (effect + 0.3 * X[:, 1] + rng.randn(n) * 0.3 > 0).astype(np.float64)
+
+    def train(ltype):
+        params = dict(objective="binary", num_leaves=7, learning_rate=0.2,
+                      min_data_in_leaf=20, tree_learner=ltype, verbosity=-1)
+        ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+        return lgb.train(params, ds, num_boost_round=10)
+
+    bst = train(learner)
+    pred = bst.predict(X)
+    acc = np.mean((pred > 0.5) == y)
+    assert acc > 0.85, f"{learner} accuracy {acc}"
+
+    dumped = bst.dump_model()
+
+    def has_cat(node):
+        if "split_feature" in node:
+            return (node["decision_type"] == "==" or
+                    has_cat(node["left_child"]) or has_cat(node["right_child"]))
+        return False
+
+    assert any(has_cat(t["tree_structure"]) for t in dumped["tree_info"])
+
+    if learner in ("data", "feature"):
+        p_serial = train("serial").predict(X)
+        np.testing.assert_allclose(pred, p_serial, rtol=1e-4, atol=1e-5)

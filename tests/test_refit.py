@@ -1,0 +1,62 @@
+"""Refit + snapshot tests (GBDT::RefitTree gbdt.cpp:266-305, snapshot_freq
+gbdt.cpp:258-262)."""
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import cli
+
+
+def _data(rng, n=1200, shift=0.0):
+    X = rng.randn(n, 5)
+    y = (X[:, 0] * 2.0 - X[:, 1] + shift + rng.randn(n) * 0.3 > 0).astype(np.float64)
+    return X, y
+
+
+def test_booster_refit_improves_on_new_data(rng):
+    X, y = _data(rng)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=10)
+    X2, y2 = _data(rng, shift=0.7)  # shifted distribution
+    acc_old = np.mean((bst.predict(X2) > 0.5) == y2)
+    refitted = bst.refit(X2, y2, decay_rate=0.5)
+    acc_new = np.mean((refitted.predict(X2) > 0.5) == y2)
+    assert acc_new >= acc_old - 1e-9, (acc_new, acc_old)
+    # structure must be identical, only leaf values change
+    t_old = bst.dump_model()["tree_info"]
+    t_new = refitted.dump_model()["tree_info"]
+    assert len(t_old) == len(t_new)
+
+    def structure(node):
+        if "split_feature" not in node:
+            return None
+        return (node["split_feature"], round(float(node["threshold"]), 6)
+                if not isinstance(node["threshold"], str) else node["threshold"],
+                structure(node["left_child"]), structure(node["right_child"]))
+
+    for a, b in zip(t_old, t_new):
+        assert structure(a["tree_structure"]) == structure(b["tree_structure"])
+
+
+def test_cli_refit_and_snapshots(rng, tmp_path):
+    X, y = _data(rng, n=800)
+    train_path = str(tmp_path / "refit.train")
+    np.savetxt(train_path, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+    model_path = str(tmp_path / "model.txt")
+    rc = cli.run([f"data={train_path}", "objective=binary", "num_trees=6",
+                  "num_leaves=7", f"output_model={model_path}",
+                  "snapshot_freq=2", "device_type=cpu", "verbosity=-1"])
+    assert rc == 0
+    assert os.path.exists(model_path + ".snapshot_iter_2")
+    assert os.path.exists(model_path + ".snapshot_iter_4")
+
+    refit_out = str(tmp_path / "refit_model.txt")
+    rc = cli.run(["task=refit", f"data={train_path}",
+                  f"input_model={model_path}", "objective=binary",
+                  f"output_model={refit_out}", "device_type=cpu",
+                  "verbosity=-1"])
+    assert rc == 0
+    pred = lgb.Booster(model_file=refit_out).predict(X)
+    assert np.mean((pred > 0.5) == y) > 0.8
