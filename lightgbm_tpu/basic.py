@@ -80,6 +80,19 @@ class Dataset:
         if isinstance(data, (str, Path)) and _is_binary_cache(str(data)):
             self._handle = _CoreDataset.load_binary(str(data), config)
             self._raw = self._handle._loaded_raw
+            # constructor args override the cached metadata, matching the
+            # python-package's set_* after a binary load
+            md = self._handle.metadata
+            if self.label is not None:
+                md.set_label(np.asarray(self.label))
+            if self.weight is not None:
+                md.set_weights(self.weight)
+            if self.group is not None:
+                md.set_query(self.group)
+            if self.init_score is not None:
+                md.set_init_score(self.init_score)
+            if self.position is not None:
+                md.set_positions(self.position)
             return self
 
         if isinstance(data, (str, Path)):
@@ -252,6 +265,13 @@ class Dataset:
         return self
 
 
+def _param_bool(v) -> bool:
+    """CLI conf values arrive as strings: 'false'/'0'/'' are falsy."""
+    if isinstance(v, str):
+        return v.strip().lower() not in ("", "0", "false", "no")
+    return bool(v)
+
+
 def _is_binary_cache(path: str) -> bool:
     """A save_binary cache is an npz (zip) file: check the PK magic."""
     try:
@@ -417,6 +437,16 @@ class Booster:
             return predict_contrib(self._gbdt.models, X,
                                    self._gbdt.num_tree_per_iteration,
                                    num_iteration)
+        if _param_bool(kwargs.get("pred_early_stop",
+                                  self.params.get("pred_early_stop"))):
+            return self._gbdt.predict(
+                X, raw_score=raw_score, num_iteration=num_iteration,
+                early_stop=(
+                    int(kwargs.get("pred_early_stop_freq",
+                                   self.params.get("pred_early_stop_freq", 10))),
+                    float(kwargs.get(
+                        "pred_early_stop_margin",
+                        self.params.get("pred_early_stop_margin", 10.0)))))
         return self._gbdt.predict(X, raw_score=raw_score, num_iteration=num_iteration)
 
     # ------------------------------------------------------------------ model

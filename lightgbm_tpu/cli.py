@@ -47,6 +47,12 @@ def run(argv: List[str]) -> int:
         except Exception:
             pass
 
+    # join the multi-host world BEFORE any JAX computation initializes a
+    # backend (jax.distributed.initialize requirement); no-op single-process
+    from .parallel.dist import init_distributed
+
+    init_distributed(config)
+
     if task == "train":
         return _task_train(config, params)
     if task in ("predict", "prediction", "test"):
@@ -141,6 +147,7 @@ def _task_predict(config: Config, params: Dict[str, str]) -> int:
 
 
 def _task_convert(config: Config, params: Dict[str, str]) -> int:
+    from .models.codegen import model_to_cpp
     from .models.serialize import GBDTModel
 
     if not config.input_model:
@@ -149,8 +156,15 @@ def _task_convert(config: Config, params: Dict[str, str]) -> int:
     out = config.convert_model or "gbdt_prediction.cpp"
     if config.convert_model_language in ("", "cpp"):
         with open(out, "w") as fh:
+            fh.write(model_to_cpp(model))
+        Log.info("Model converted to if-else C++ at %s", out)
+    elif config.convert_model_language == "json":
+        with open(out, "w") as fh:
             fh.write(model.dump_json())
         Log.info("Model converted (JSON form) to %s", out)
+    else:
+        Log.fatal("Unknown convert_model_language %s",
+                  config.convert_model_language)
     return 0
 
 

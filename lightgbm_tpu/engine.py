@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import collections
 import copy
+import os
 from typing import Any, Callable, Dict, Iterable, List, Optional, Union
 
 import numpy as np
@@ -17,6 +18,7 @@ from .basic import Booster, Dataset
 from .callback import CallbackEnv, EarlyStopException
 from .config import key_alias_transform
 from .utils.log import Log, LightGBMError
+from .utils.timer import global_timer
 
 
 def train(params: Dict[str, Any], train_set: Dataset,
@@ -89,6 +91,35 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     booster.best_iteration = -1
     is_finished = False
+    # §5 tracing: LGBM_TPU_PROFILE_DIR wraps the boosting loop in a
+    # jax.profiler trace (viewable in TensorBoard/Perfetto), composing with
+    # the LGBM_TPU_TIMETAG per-scope TraceAnnotations from utils/timer.py
+    profile_dir = os.environ.get("LGBM_TPU_PROFILE_DIR")
+    if profile_dir:
+        import jax.profiler
+
+        jax.profiler.start_trace(profile_dir)
+    try:
+        is_finished = _train_loop(
+            booster, params, feval, fobj, init_iteration, num_boost_round,
+            callbacks_before, callbacks_after)
+    finally:
+        if profile_dir:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+            Log.info("Profiler trace written to %s", profile_dir)
+        if global_timer.enabled:
+            Log.info("%s", global_timer.report())
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster.current_iteration()
+    return booster
+
+
+def _train_loop(booster, params, feval, fobj, init_iteration, num_boost_round,
+                callbacks_before, callbacks_after) -> bool:
+    is_finished = False
+    evaluation_result_list = None
     for i in range(init_iteration, init_iteration + num_boost_round):
         if is_finished:
             break
@@ -117,9 +148,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for item in evaluation_result_list or []:
         booster.best_score[item[0]][item[1]] = item[2]
-    if booster.best_iteration <= 0:
-        booster.best_iteration = booster.current_iteration()
-    return booster
+    return is_finished
 
 
 def _wants_train_metric(params) -> bool:

@@ -45,6 +45,24 @@ def _is_number(tok: str) -> bool:
         return tok.lower() in ("nan", "inf", "-inf", "na", "")
 
 
+def _native_parse_dense(path: str, delim: str,
+                        skip_header: int) -> Optional[np.ndarray]:
+    """C++ fast path (native/parser.cpp); None -> caller falls back."""
+    from ..native import get_parser
+
+    native = get_parser()
+    if native is None:
+        return None
+    try:
+        buf, nrows, ncols = native.parse_dense(
+            path, 0 if delim == " " else ord(delim), int(skip_header))
+    except Exception:  # noqa: BLE001 - malformed file: numpy fallback
+        return None
+    if nrows == 0 or ncols == 0:
+        return None
+    return np.frombuffer(buf, dtype=np.float64).reshape(nrows, ncols)
+
+
 def parse_file(path: str, header: bool = False, label_column: str = "0",
                ignore_columns: Sequence = (), max_rows: Optional[int] = None
                ) -> Tuple[np.ndarray, np.ndarray, List[str]]:
@@ -80,9 +98,13 @@ def parse_file(path: str, header: bool = False, label_column: str = "0",
     elif label_column not in (None, ""):
         label_idx = int(label_column)
 
-    raw = np.genfromtxt(path, delimiter=delim if delim != " " else None,
-                        skip_header=skip, dtype=np.float64,
-                        max_rows=max_rows, loose=True, invalid_raise=False)
+    raw = None
+    if max_rows is None:
+        raw = _native_parse_dense(path, delim, skip)
+    if raw is None:
+        raw = np.genfromtxt(path, delimiter=delim if delim != " " else None,
+                            skip_header=skip, dtype=np.float64,
+                            max_rows=max_rows, loose=True, invalid_raise=False)
     if raw.ndim == 1:
         raw = raw.reshape(-1, 1)
     ncol = raw.shape[1]
@@ -107,6 +129,21 @@ def parse_file(path: str, header: bool = False, label_column: str = "0",
 
 
 def _parse_libsvm(path: str, header: bool) -> Tuple[np.ndarray, np.ndarray, List[str]]:
+    from ..native import get_parser
+
+    native = get_parser()
+    if native is not None:
+        try:
+            lab_buf, tri_buf, max_feat = native.parse_libsvm(path, int(header))
+            y = np.frombuffer(lab_buf, dtype=np.float64).copy()
+            trips = np.frombuffer(tri_buf, dtype=np.float64).reshape(-1, 3)
+            X = np.zeros((len(y), int(max_feat) + 1), dtype=np.float64)
+            X[trips[:, 0].astype(np.int64), trips[:, 1].astype(np.int64)] = \
+                trips[:, 2]
+            names = [f"Column_{i}" for i in range(int(max_feat) + 1)]
+            return X, y, names
+        except Exception:  # noqa: BLE001 - fall back to the python path
+            pass
     rows: List[dict] = []
     labels: List[float] = []
     max_feat = -1

@@ -1,0 +1,77 @@
+"""Multi-process training launcher (the torchrun/dask-analog orchestrator).
+
+The reference ships parallel orchestration through its socket machinery plus
+external wrappers (Dask in python-package/lightgbm/dask.py, MPI via mpirun);
+the TPU-native equivalent is one JAX process per host joined through
+`jax.distributed`. This launcher covers the single-machine multi-process
+case (simulating a multi-host cluster, or driving multiple local
+accelerator processes):
+
+    python -m lightgbm_tpu.launch -n 4 -- config=train.conf
+
+spawns 4 worker processes with JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+JAX_PROCESS_ID set; each worker runs the normal CLI (lightgbm_tpu.cli), and
+parallel/dist.py picks the env vars up in init_distributed. For a REAL
+multi-host pod, run the same CLI once per host with those env vars (or a
+machine-list conf) instead.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+from typing import List
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main(argv: List[str] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.launch",
+        description="Spawn N jax.distributed worker processes running the "
+                    "lightgbm_tpu CLI")
+    parser.add_argument("-n", "--nproc", type=int, default=2,
+                        help="number of worker processes")
+    parser.add_argument("--port", type=int, default=0,
+                        help="coordinator port (default: pick a free one)")
+    parser.add_argument("--devices-per-proc", type=int, default=0,
+                        help="force N virtual CPU devices per process "
+                             "(local simulation)")
+    parser.add_argument("cli_args", nargs=argparse.REMAINDER,
+                        help="arguments forwarded to lightgbm_tpu.cli "
+                             "(prefix with --)")
+    args = parser.parse_args(argv)
+    cli_args = [a for a in args.cli_args if a != "--"]
+    port = args.port or _free_port()
+
+    procs = []
+    for pid in range(args.nproc):
+        env = dict(os.environ)
+        env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["JAX_NUM_PROCESSES"] = str(args.nproc)
+        env["JAX_PROCESS_ID"] = str(pid)
+        if args.devices_per_proc:
+            env["JAX_PLATFORMS"] = "cpu"
+            flags = env.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.devices_per_proc}").strip()
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "lightgbm_tpu.cli", *cli_args], env=env))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

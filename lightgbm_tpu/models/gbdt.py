@@ -264,10 +264,7 @@ class GBDT:
         train score of every row via bin-space traversal — the train-time
         ScoreUpdater::AddScore(tree) path DART/RF renormalization needs."""
         if tree.is_linear:
-            packed = pack_ensemble([tree], fixed_leaves=self.config.num_leaves,
-                                   fixed_depth=self._depth_bound)
-            delta = predict_raw(packed, self._train_raw_dev())[:, 0]
-            self.score = self.score.at[class_id].add(delta)
+            self._add_linear_tree_score(tree, class_id)
             return
         score = add_tree_to_score(
             tree, self.train_set, self.tree_learner.bins_dev,
@@ -287,15 +284,18 @@ class GBDT:
                                                     dtype=jnp.float32)
         return self._train_raw_dev_cache
 
+    def _add_linear_tree_score(self, tree: Tree, class_id: int) -> None:
+        """Linear leaves need raw feature values, not leaf constants: score
+        through the packed linear predictor (AddPredictionToScore with
+        is_linear, gbdt.cpp)."""
+        packed = pack_ensemble([tree], fixed_leaves=self.config.num_leaves,
+                               fixed_depth=self._depth_bound)
+        delta = predict_raw(packed, self._train_raw_dev())[:, 0]
+        self.score = self.score.at[class_id].add(delta)
+
     def _update_train_score(self, tree: Tree, class_id: int) -> None:
         if tree.is_linear:
-            # linear leaves need raw feature values, not leaf constants:
-            # score through the packed linear predictor (AddPredictionToScore
-            # with is_linear, gbdt.cpp)
-            packed = pack_ensemble([tree], fixed_leaves=self.config.num_leaves,
-                                   fixed_depth=self._depth_bound)
-            delta = predict_raw(packed, self._train_raw_dev())[:, 0]
-            self.score = self.score.at[class_id].add(delta)
+            self._add_linear_tree_score(tree, class_id)
             return
         part = self.tree_learner.partition
         score = self.score[class_id]
@@ -363,10 +363,19 @@ class GBDT:
         return self._packed_cache[1]
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
-                num_iteration: int = 0) -> np.ndarray:
+                num_iteration: int = 0,
+                early_stop: Optional[Tuple[int, float]] = None) -> np.ndarray:
         packed = self._packed(num_iteration)
-        out = predict_raw(packed, jnp.asarray(X, dtype=jnp.float32),
-                          self.num_tree_per_iteration)
+        if early_stop is not None and packed.num_trees > 0:
+            from ..ops.predict import predict_raw_early_stop
+
+            freq, margin = early_stop
+            out = predict_raw_early_stop(
+                packed, jnp.asarray(X, dtype=jnp.float32),
+                self.num_tree_per_iteration, freq, margin)
+        else:
+            out = predict_raw(packed, jnp.asarray(X, dtype=jnp.float32),
+                              self.num_tree_per_iteration)
         if self.average_output and packed.num_trees > 0:
             out = out / (packed.num_trees // self.num_tree_per_iteration)
         if not raw_score and self.objective is not None:

@@ -1,0 +1,64 @@
+"""On-demand build + load of the native C++ parser extension.
+
+The reference ships its parser stack as C++ (src/io/parser.cpp); here the
+native module is compiled once per interpreter ABI with plain g++ against
+the CPython headers (no pybind11 dependency) into this package directory,
+then dlopen'd as a normal extension module. Every caller treats a missing
+toolchain or failed build as "no native parser" and falls back to the
+pure-numpy path in io/parser.py.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "parser.cpp")
+_cached = None  # None = not tried, False = unavailable, module otherwise
+
+
+def _so_path() -> str:
+    tag = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_DIR, f"_lgbt_parser{tag}")
+
+
+def _build() -> Optional[str]:
+    so = _so_path()
+    if (os.path.exists(so)
+            and os.path.getmtime(so) >= os.path.getmtime(_SRC)):
+        return so
+    include = sysconfig.get_paths()["include"]
+    cmd = ["g++", "-O2", "-shared", "-fPIC", f"-I{include}", _SRC, "-o", so]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception:  # noqa: BLE001 - toolchain missing/failed: no native
+        return None
+    return so
+
+
+def get_parser():
+    """The compiled _lgbt_parser module, or None when unavailable."""
+    global _cached
+    if _cached is not None:
+        return _cached or None
+    if os.environ.get("LIGHTGBM_TPU_NO_NATIVE"):
+        _cached = False
+        return None
+    so = _build()
+    if so is None:
+        _cached = False
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location("_lgbt_parser", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        sys.modules["_lgbt_parser"] = mod
+        _cached = mod
+    except Exception:  # noqa: BLE001
+        _cached = False
+        return None
+    return _cached

@@ -1,0 +1,213 @@
+// Native text parser for lightgbm_tpu.
+//
+// TPU-native counterpart of the reference's C++ Parser stack
+// (src/io/parser.cpp CSVParser/TSVParser/LibSVMParser): tokenizes CSV/TSV
+// (single-char or whitespace delimited) and LibSVM files with strtod in one
+// pass over a buffered read. Exposed as a tiny CPython extension module
+// (no pybind11 — plain Python C API) returning raw double buffers the
+// Python side wraps with np.frombuffer; built on demand by build.py.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+bool read_file(const char* path, std::string* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  out->resize(static_cast<size_t>(size));
+  size_t got = size ? std::fread(&(*out)[0], 1, static_cast<size_t>(size), f)
+                    : 0;
+  std::fclose(f);
+  out->resize(got);
+  return true;
+}
+
+inline double parse_token(const char* tok, const char* end) {
+  if (tok == end) return NAN;
+  char* stop = nullptr;
+  double v = std::strtod(tok, &stop);
+  if (stop == tok) {
+    // na / NA / ? / empty -> NaN (reference Atof NaN semantics)
+    return NAN;
+  }
+  return v;
+}
+
+// dense CSV/TSV: delim == 0 means "any whitespace run"
+PyObject* parse_dense(PyObject*, PyObject* args) {
+  const char* path;
+  int delim_int, skip_header;
+  if (!PyArg_ParseTuple(args, "sii", &path, &delim_int, &skip_header)) {
+    return nullptr;
+  }
+  const char delim = static_cast<char>(delim_int);
+  std::string buf;
+  if (!read_file(path, &buf)) {
+    PyErr_SetString(PyExc_OSError, "cannot open data file");
+    return nullptr;
+  }
+  std::vector<double> values;
+  values.reserve(1 << 20);
+  Py_ssize_t nrows = 0, ncols = -1;
+  const char* p = buf.data();
+  const char* fend = p + buf.size();
+  int line_no = 0;
+  while (p < fend) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(fend - p)));
+    if (!line_end) line_end = fend;
+    const char* q = p;
+    const char* qe = line_end;
+    if (qe > q && qe[-1] == '\r') --qe;
+    ++line_no;
+    if (skip_header && line_no == 1) {
+      p = line_end + 1;
+      continue;
+    }
+    if (q == qe) {  // blank line
+      p = line_end + 1;
+      continue;
+    }
+    Py_ssize_t row_cols = 0;
+    if (delim == 0) {
+      while (q < qe) {
+        while (q < qe && std::isspace(static_cast<unsigned char>(*q))) ++q;
+        if (q >= qe) break;
+        const char* tok = q;
+        while (q < qe && !std::isspace(static_cast<unsigned char>(*q))) ++q;
+        values.push_back(parse_token(tok, q));
+        ++row_cols;
+      }
+    } else {
+      const char* tok = q;
+      for (;; ++q) {
+        if (q == qe || *q == delim) {
+          values.push_back(parse_token(tok, q));
+          ++row_cols;
+          if (q == qe) break;
+          tok = q + 1;
+        }
+      }
+    }
+    if (ncols < 0) {
+      ncols = row_cols;
+    } else if (row_cols != ncols) {
+      PyErr_SetString(PyExc_ValueError, "inconsistent column count");
+      return nullptr;
+    }
+    ++nrows;
+    p = line_end + 1;
+  }
+  if (ncols < 0) ncols = 0;
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(values.data()),
+      static_cast<Py_ssize_t>(values.size() * sizeof(double)));
+  if (!bytes) return nullptr;
+  return Py_BuildValue("(Nnn)", bytes, nrows, ncols);
+}
+
+// LibSVM: label idx:val idx:val ... -> (labels, triplets of (row, col, val))
+PyObject* parse_libsvm(PyObject*, PyObject* args) {
+  const char* path;
+  int skip_header;
+  if (!PyArg_ParseTuple(args, "si", &path, &skip_header)) return nullptr;
+  std::string buf;
+  if (!read_file(path, &buf)) {
+    PyErr_SetString(PyExc_OSError, "cannot open data file");
+    return nullptr;
+  }
+  std::vector<double> labels;
+  std::vector<double> trips;  // row, col, val
+  long max_feat = -1;
+  const char* p = buf.data();
+  const char* fend = p + buf.size();
+  int line_no = 0;
+  long row = 0;
+  while (p < fend) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(fend - p)));
+    if (!line_end) line_end = fend;
+    const char* q = p;
+    const char* qe = line_end;
+    if (qe > q && qe[-1] == '\r') --qe;
+    ++line_no;
+    if ((skip_header && line_no == 1) || q == qe) {
+      p = line_end + 1;
+      continue;
+    }
+    bool first = true;
+    while (q < qe) {
+      while (q < qe && std::isspace(static_cast<unsigned char>(*q))) ++q;
+      if (q >= qe) break;
+      const char* tok = q;
+      while (q < qe && !std::isspace(static_cast<unsigned char>(*q))) ++q;
+      const char* colon = static_cast<const char*>(
+          std::memchr(tok, ':', static_cast<size_t>(q - tok)));
+      if (first && !colon) {
+        labels.push_back(parse_token(tok, q));
+        first = false;
+      } else if (colon) {
+        if (first) {  // qid-less line starting with idx:val -> label 0
+          labels.push_back(0.0);
+          first = false;
+        }
+        // the index must be purely numeric: `qid:3`-style tokens are NOT
+        // silently coerced (strtol would map them to feature 0) — error out
+        // so the caller surfaces the same failure as the python parser
+        for (const char* c = tok; c < colon; ++c) {
+          if (!std::isdigit(static_cast<unsigned char>(*c))) {
+            PyErr_Format(PyExc_ValueError,
+                         "non-numeric feature index in libsvm token at "
+                         "line %d", line_no);
+            return nullptr;
+          }
+        }
+        long idx = std::strtol(tok, nullptr, 10);
+        double val = parse_token(colon + 1, q);
+        if (idx > max_feat) max_feat = idx;
+        trips.push_back(static_cast<double>(row));
+        trips.push_back(static_cast<double>(idx));
+        trips.push_back(val);
+      }
+    }
+    if (first) labels.push_back(0.0);
+    ++row;
+    p = line_end + 1;
+  }
+  PyObject* lab = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(labels.data()),
+      static_cast<Py_ssize_t>(labels.size() * sizeof(double)));
+  PyObject* tri = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(trips.data()),
+      static_cast<Py_ssize_t>(trips.size() * sizeof(double)));
+  if (!lab || !tri) return nullptr;
+  return Py_BuildValue("(NNl)", lab, tri, max_feat);
+}
+
+PyMethodDef methods[] = {
+    {"parse_dense", parse_dense, METH_VARARGS,
+     "parse_dense(path, delim_ord, skip_header) -> (bytes, nrows, ncols)"},
+    {"parse_libsvm", parse_libsvm, METH_VARARGS,
+     "parse_libsvm(path, skip_header) -> (labels, triplets, max_feat)"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_lgbt_parser",
+                         "native text parser", -1, methods,
+                         nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__lgbt_parser(void) {
+  return PyModule_Create(&moduledef);
+}

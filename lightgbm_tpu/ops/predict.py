@@ -254,3 +254,45 @@ def predict_raw(packed: PackedEnsemble, X: jax.Array, num_tree_per_iteration: in
     scores = jax.vmap(tree_score)(jnp.arange(T))  # [T, N]
     scores = scores.reshape(T // num_tree_per_iteration, num_tree_per_iteration, X.shape[0])
     return scores.sum(axis=0).T  # [N, C]
+
+
+def predict_raw_early_stop(packed: PackedEnsemble, X: jax.Array,
+                           num_tree_per_iteration: int, round_period: int,
+                           margin_threshold: float) -> np.ndarray:
+    """Raw scores with prediction early stopping
+    (src/boosting/prediction_early_stop.cpp): every `round_period`
+    iterations, rows whose margin — |score| for binary, top-2 class gap for
+    multiclass — exceeds `margin_threshold` stop traversing further trees.
+
+    TPU formulation: the reference's per-row sequential check becomes
+    host-chunked batches — still-active rows are compacted (power-of-two
+    padded so jit caches stay bounded) and only they evaluate the next tree
+    block. Batch workloads with confident rows skip most of the ensemble.
+    """
+    from .partition import bucket_size
+
+    C = num_tree_per_iteration
+    T = packed.num_trees
+    N = X.shape[0]
+    out = np.zeros((N, C), dtype=np.float64)
+    active = np.ones(N, dtype=bool)
+    block = max(round_period, 1) * C
+    for start in range(0, T, block):
+        idx = np.nonzero(active)[0]
+        if idx.size == 0:
+            break
+        pad = bucket_size(idx.size, 256)
+        idx_pad = np.zeros(pad, dtype=np.int64)
+        idx_pad[: idx.size] = idx
+        Xa = jnp.asarray(X)[jnp.asarray(idx_pad)]
+        sl = packed.tree_slice(start, min(start + block, T))
+        delta = np.asarray(predict_raw(sl, Xa, C))[: idx.size]
+        out[idx] += delta
+        scores = out[idx]
+        if C == 1:
+            stop = np.abs(scores[:, 0]) > margin_threshold
+        else:
+            top2 = np.partition(scores, -2, axis=1)[:, -2:]
+            stop = (top2[:, 1] - top2[:, 0]) > margin_threshold
+        active[idx[stop]] = False
+    return out

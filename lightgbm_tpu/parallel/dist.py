@@ -54,7 +54,7 @@ def init_distributed(config=None,
     when a multi-process runtime is active after the call.
     """
     global _initialized
-    if _initialized or jax.process_count() > 1:
+    if _initialized:
         return jax.process_count() > 1
 
     env_addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
@@ -88,6 +88,8 @@ def init_distributed(config=None,
                         break
 
     if coordinator_address is None:
+        # no multi-host config: don't touch JAX at all (process_count would
+        # initialize the backend, breaking a later explicit initialize())
         return False
     if num_processes is None or process_id is None:
         Log.fatal("Multi-host init needs num_processes and process_id "
@@ -95,9 +97,13 @@ def init_distributed(config=None,
                   "list containing this host)")
     Log.info("Joining distributed world: coordinator=%s process %d/%d",
              coordinator_address, process_id, num_processes)
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=int(num_processes),
-                               process_id=int(process_id))
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=int(num_processes),
+                                   process_id=int(process_id))
+    except RuntimeError as e:
+        if "already" not in str(e):  # user initialized earlier: fine
+            raise
     _initialized = True
     return jax.process_count() > 1
 

@@ -291,7 +291,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
     def _feature_hist_row(self, state: _LeafState,
                           dense_f: int) -> np.ndarray:
         # feature-major layout: the row IS the aggregated feature histogram
-        return host_value(self._hist_for_scan(state.hist)[dense_f])
+        # (same accessor as the categorical bin stats)
+        return self._cat_bin_stats(state, -1, dense_f)
 
 
 class FeatureParallelTreeLearner(SerialTreeLearner):

@@ -53,9 +53,11 @@ class CEGB:
         self.lazy = per_used(config.cegb_penalty_feature_lazy,
                              "cegb_penalty_feature_lazy")
         self.used_in_split = np.zeros(self.F, dtype=bool)
-        # per-(feature, row) "feature already computed for this row" marks
-        self.seen: Optional[np.ndarray] = (
-            np.zeros((self.F, self.num_data), dtype=bool)
+        # per-(feature, row) "feature already computed for this row" marks,
+        # bit-packed like the reference's Common::EmptyBitset (N/8 bytes per
+        # feature instead of N bools)
+        self.seen_bits: Optional[np.ndarray] = (
+            np.zeros((self.F, (self.num_data + 7) // 8), dtype=np.uint8)
             if self.lazy is not None else None)
 
     @property
@@ -71,7 +73,10 @@ class CEGB:
             vec += np.where(self.used_in_split, 0.0,
                             self.tradeoff * self.coupled)
         if self.lazy is not None and leaf_rows is not None and len(leaf_rows):
-            unseen = (~self.seen[:, leaf_rows]).sum(axis=1)  # [F]
+            byte_idx = leaf_rows >> 3
+            bit = (leaf_rows & 7).astype(np.uint8)
+            seen = (self.seen_bits[:, byte_idx] >> bit) & 1  # [F, R]
+            unseen = len(leaf_rows) - seen.sum(axis=1)
             vec += self.tradeoff * self.lazy * unseen
         return vec.astype(np.float32)
 
@@ -84,6 +89,7 @@ class CEGB:
                  and not self.used_in_split[dense_f]
                  and self.coupled[dense_f] > 0)
         self.used_in_split[dense_f] = True
-        if self.seen is not None and leaf_rows is not None:
-            self.seen[dense_f, leaf_rows] = True
+        if self.seen_bits is not None and leaf_rows is not None:
+            np.bitwise_or.at(self.seen_bits[dense_f], leaf_rows >> 3,
+                             np.uint8(1) << (leaf_rows & 7).astype(np.uint8))
         return bool(newly)

@@ -18,6 +18,7 @@ by the frontier map; LRU capping arrives with histogram_pool_size support).
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
@@ -72,6 +73,15 @@ class SerialTreeLearner:
         self.partition: Optional[RowPartition] = None
         self.col_sampler = ColSampler(config, self.meta.real_feature)
         self._tree_feature_mask: Optional[jax.Array] = None
+        # HistogramPool byte cap (feature_histogram.hpp:1367-1597): when
+        # histogram_pool_size (MB) is set, at most `_pool_cap` leaf
+        # histograms stay materialized; LRU-evicted ones recompute on demand
+        self._pool_cap = 0
+        if config.histogram_pool_size > 0:
+            hist_bytes = (len(dataset.groups) * self.group_bin_padded * 3 * 4)
+            self._pool_cap = max(
+                2, int(config.histogram_pool_size * 1024 * 1024 / hist_bytes))
+        self._hist_lru: "OrderedDict[int, bool]" = OrderedDict()
         self._has_mc = bool(dataset.monotone_constraints
                             and any(dataset.monotone_constraints))
         if self._has_mc and config.monotone_constraints_method not in (
@@ -193,6 +203,7 @@ class SerialTreeLearner:
     def _begin_tree(self, gh_ext: jax.Array,
                     bag_indices: Optional[np.ndarray]) -> None:
         self._gh = self._prepare_gh(gh_ext)
+        self._hist_lru.clear()
         partition = RowPartition(self.num_data)
         if bag_indices is not None:
             partition.set_used_indices(bag_indices)
@@ -355,6 +366,24 @@ class SerialTreeLearner:
                          right_sum_h=rh, right_count=int(round(rc)),
                          left_output=lout, right_output=rout)
 
+    def _pool_touch(self, frontier: Dict[int, _LeafState], leaf: int) -> None:
+        """Materialize an evicted leaf histogram and refresh its LRU slot,
+        evicting the coldest leaves past the pool cap."""
+        state = frontier[leaf]
+        if state.hist is None:
+            with global_timer.scope("hist_recompute"):
+                state.hist = self._leaf_hist(leaf)
+        if not self._pool_cap:
+            return
+        lru = self._hist_lru
+        lru.pop(leaf, None)
+        lru[leaf] = True
+        while len(lru) > self._pool_cap:
+            old, _ = lru.popitem(last=False)
+            old_state = frontier.get(old)
+            if old_state is not None and old_state.hist is not None:
+                old_state.hist = None
+
     def _find_split(self, frontier: Dict[int, _LeafState], leaf: int) -> None:
         state = frontier[leaf]
         cnt = state.totals[2]
@@ -363,6 +392,7 @@ class SerialTreeLearner:
                 or state.totals[1] < 2 * self.config.min_sum_hessian_in_leaf):
             state.split = SplitInfo()
             return
+        self._pool_touch(frontier, leaf)
         with global_timer.scope("find_best_split"):
             state.split = self._search_split(state, leaf)
 
@@ -379,6 +409,7 @@ class SerialTreeLearner:
 
         state = frontier[leaf]
         new_leaf = tree.num_leaves
+        self._pool_touch(frontier, leaf)  # parent hist needed for subtraction
 
         # 1. record the split in the tree (real-value threshold / bitset)
         parent_output = _leaf_output_host(
@@ -471,6 +502,9 @@ class SerialTreeLearner:
             small_hist if small == new_leaf else big_hist, right_totals, None,
             depth, child_path, rbounds)
         state.hist = None  # release parent histogram
+        self._hist_lru.pop(leaf, None)
+        self._pool_touch(frontier, leaf)
+        self._pool_touch(frontier, new_leaf)
         refresh_frontier = False
         if self.cegb is not None:
             rows = None

@@ -59,3 +59,25 @@ def test_forced_splits(rng, tmp_path):
         assert abs(float(root["threshold"]) - 0.25) < 0.3  # binned threshold
         assert root["left_child"].get("split_feature") == 3
     assert np.isfinite(bst.predict(X)).all()
+
+
+def test_histogram_pool_cap_exact(rng):
+    """histogram_pool_size LRU eviction + recompute must not change the
+    model (feature_histogram.hpp HistogramPool semantics)."""
+    X, y = _data(rng, n=1500)
+    base = {"objective": "binary", "num_leaves": 31, "min_data_in_leaf": 10,
+            "verbosity": -1}
+    p_full = lgb.train(base, lgb.Dataset(X, label=y),
+                       num_boost_round=5).predict(X)
+    p_cap = lgb.train({**base, "histogram_pool_size": 0.001},
+                      lgb.Dataset(X, label=y), num_boost_round=5).predict(X)
+    np.testing.assert_allclose(p_cap, p_full, rtol=1e-6)
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Dataset as CoreDataset
+    from lightgbm_tpu.treelearner.serial import SerialTreeLearner
+
+    cfg = Config({**base, "histogram_pool_size": 0.001})
+    core = CoreDataset.from_matrix(X, label=y, config=cfg)
+    learner = SerialTreeLearner(cfg, core)
+    assert learner._pool_cap >= 2

@@ -106,3 +106,33 @@ def test_two_process_data_parallel_matches_serial(tmp_path):
                 for ln in text.splitlines() if ln.split("=")[0] in names}
 
     assert fields(dist_tree) == fields(serial_tree.to_string())
+
+
+@pytest.mark.slow
+def test_launcher_two_process_cli(tmp_path):
+    """python -m lightgbm_tpu.launch spawns a jax.distributed worker group
+    running the reference-style CLI end to end."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(600, 4)
+    y = (X[:, 0] - X[:, 1] + 0.2 * rng.randn(600) > 0).astype(np.float64)
+    train_path = str(tmp_path / "launch.train")
+    np.savetxt(train_path, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+    model_path = str(tmp_path / "launch_model.txt")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.launch", "-n", "2",
+         "--devices-per-proc", "2", "--",
+         f"data={train_path}", "objective=binary", "num_trees=3",
+         "num_leaves=7", "tree_learner=data", "min_data_in_leaf=10",
+         f"output_model={model_path}", "device_type=cpu", "verbosity=-1"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert os.path.exists(model_path)
+
+    import lightgbm_tpu as lgb
+
+    pred = lgb.Booster(model_file=model_path).predict(X)
+    assert np.mean((pred > 0.5) == y) > 0.85

@@ -1,0 +1,38 @@
+"""Prediction early-stop tests (src/boosting/prediction_early_stop.cpp)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def test_binary_early_stop_margin(rng):
+    n = 2000
+    X = rng.randn(n, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "learning_rate": 0.3, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=40)
+    full = bst.predict(X, raw_score=True)
+    es = bst.predict(X, raw_score=True, pred_early_stop=True,
+                     pred_early_stop_freq=5, pred_early_stop_margin=2.0)
+    # stopped rows have |raw| already past the margin; agreement on sign
+    assert np.mean(np.sign(es) == np.sign(full)) > 0.99
+    stopped = np.abs(es - full) > 1e-9
+    assert stopped.any()  # early stop actually kicked in
+    assert np.all(np.abs(es[stopped]) > 2.0)
+    # huge margin => identical to full prediction
+    same = bst.predict(X, raw_score=True, pred_early_stop=True,
+                       pred_early_stop_margin=1e9)
+    np.testing.assert_allclose(same, full, rtol=1e-6)
+
+
+def test_multiclass_early_stop(rng):
+    n = 1500
+    X = rng.randn(n, 4)
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)).astype(float)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "learning_rate": 0.3, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=30)
+    full = bst.predict(X)
+    es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                     pred_early_stop_margin=3.0)
+    assert np.mean(es.argmax(axis=1) == full.argmax(axis=1)) > 0.99
