@@ -17,7 +17,8 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
 
 import numpy as np
 
-from .config import Config, key_alias_transform, parse_objective_alias
+from .config import (Config, key_alias_transform, param_bool,
+                     parse_objective_alias)
 from .io.dataset import Dataset as _CoreDataset
 from .io.parser import (load_positions, load_query_boundaries, load_weights,
                         parse_file)
@@ -265,13 +266,6 @@ class Dataset:
         return self
 
 
-def _param_bool(v) -> bool:
-    """CLI conf values arrive as strings: 'false'/'0'/'' are falsy."""
-    if isinstance(v, str):
-        return v.strip().lower() not in ("", "0", "false", "no")
-    return bool(v)
-
-
 def _is_binary_cache(path: str) -> bool:
     """A save_binary cache is an npz (zip) file: check the PK magic."""
     try:
@@ -437,8 +431,8 @@ class Booster:
             return predict_contrib(self._gbdt.models, X,
                                    self._gbdt.num_tree_per_iteration,
                                    num_iteration)
-        if _param_bool(kwargs.get("pred_early_stop",
-                                  self.params.get("pred_early_stop"))):
+        if param_bool(kwargs.get("pred_early_stop",
+                                 self.params.get("pred_early_stop"))):
             return self._gbdt.predict(
                 X, raw_score=raw_score, num_iteration=num_iteration,
                 early_stop=(

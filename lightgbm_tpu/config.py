@@ -87,6 +87,22 @@ def parse_metric_alias(name: str) -> str:
     return _METRIC_ALIAS.get(name, name)
 
 
+def param_bool(value: Any, default: bool = False) -> bool:
+    """Reference bool-string coercion (true/1/+/yes vs false/0/-/no) for
+    values reaching python surfaces as raw conf strings; non-coercible
+    strings fall back to `default` instead of fataling."""
+    if value is None:
+        return default
+    if isinstance(value, str):
+        v = value.strip().lower()
+        if v in ("true", "1", "+", "yes"):
+            return True
+        if v in ("false", "0", "-", "no", ""):
+            return False
+        return default
+    return bool(value)
+
+
 def _coerce(name: str, ptype: str, value: Any) -> Any:
     if isinstance(value, str):
         v = value.strip()

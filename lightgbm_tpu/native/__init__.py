@@ -32,10 +32,18 @@ def _build() -> Optional[str]:
             and os.path.getmtime(so) >= os.path.getmtime(_SRC)):
         return so
     include = sysconfig.get_paths()["include"]
-    cmd = ["g++", "-O2", "-shared", "-fPIC", f"-I{include}", _SRC, "-o", so]
+    # build to a per-process temp file + atomic rename: concurrent workers
+    # (lightgbm_tpu.launch) must never dlopen a half-written .so
+    tmp = f"{so}.build.{os.getpid()}"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", f"-I{include}", _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so)
     except Exception:  # noqa: BLE001 - toolchain missing/failed: no native
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
     return so
 

@@ -290,7 +290,8 @@ def predict_raw_early_stop(packed: PackedEnsemble, X: jax.Array,
         out[idx] += delta
         scores = out[idx]
         if C == 1:
-            stop = np.abs(scores[:, 0]) > margin_threshold
+            # binary margin is 2*|pred| (prediction_early_stop.cpp:65)
+            stop = 2.0 * np.abs(scores[:, 0]) > margin_threshold
         else:
             top2 = np.partition(scores, -2, axis=1)[:, -2:]
             stop = (top2[:, 1] - top2[:, 0]) > margin_threshold

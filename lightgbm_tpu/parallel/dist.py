@@ -56,6 +56,14 @@ def init_distributed(config=None,
     global _initialized
     if _initialized:
         return jax.process_count() > 1
+    try:  # user may have initialized jax.distributed themselves
+        from jax._src import distributed as _dist_state
+
+        if getattr(_dist_state.global_state, "client", None) is not None:
+            _initialized = True
+            return jax.process_count() > 1
+    except Exception:  # noqa: BLE001 - internal layout changed: fall through
+        pass
 
     env_addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
     env_np = os.environ.get("JAX_NUM_PROCESSES")
@@ -102,7 +110,8 @@ def init_distributed(config=None,
                                    num_processes=int(num_processes),
                                    process_id=int(process_id))
     except RuntimeError as e:
-        if "already" not in str(e):  # user initialized earlier: fine
+        # "should only be called once" / "already initialized": fine
+        if "once" not in str(e) and "already" not in str(e):
             raise
     _initialized = True
     return jax.process_count() > 1

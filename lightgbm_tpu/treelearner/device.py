@@ -101,15 +101,22 @@ def _decide_go_left(gb, thresh, default_left, missing_type, default_bin,
     return jnp.where(is_missing, default_left, fbin <= thresh)
 
 
-@partial(jax.jit, static_argnames=("num_leaves", "num_bins", "max_depth"))
+@partial(jax.jit,
+         static_argnames=("num_leaves", "num_bins", "max_depth", "quantized"))
 def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
                         meta, tables: FeatureTables, params: jax.Array,
                         feature_mask: jax.Array,
-                        num_leaves: int, num_bins: int, max_depth: int):
+                        num_leaves: int, num_bins: int, max_depth: int,
+                        quantized: bool = False,
+                        scale_vec: Optional[jax.Array] = None):
     """Grow one leaf-wise tree fully on device.
 
     bins [G, N], gh [N, 3] (bagged-out rows must have zero gh),
     leaf_id0 [N] (0 for in-bag rows, -1 otherwise).
+    quantized: gh is int8 (g_int, h_int, 1); histograms accumulate exact
+    int32 on the MXU and re-enter float space via scale_vec at scan time —
+    the on-device twin of the serial learner's quantized path, with the
+    bonus that the histogram-subtraction trick becomes exact integer math.
     Returns (rec_store [L-1, STORE], leaf_id [N], num_leaves_final).
     """
     L = num_leaves
@@ -118,8 +125,22 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
     neg_inf = jnp.float32(-jnp.inf)
 
     def masked_hist(mask):
+        if quantized:
+            ghm = jnp.where(mask[:, None], gh, jnp.zeros((), gh.dtype))
+            return build_histogram(bins, ghm, num_bins,
+                                   compute_dtype=jnp.int8)
         return build_histogram(bins, jnp.where(mask[:, None], gh, 0.0),
                                num_bins)
+
+    def scan_hist(hist):
+        if quantized:
+            return hist.astype(jnp.float32) * scale_vec
+        return hist
+
+    def hist_totals(hist):
+        if quantized:
+            return hist[0].sum(axis=0).astype(jnp.float32) * scale_vec
+        return hist[0].sum(axis=0)
 
     def guard(rec, cnt, sum_h, depth):
         """BeforeFindBestSplit gates (serial_tree_learner.cpp:343)."""
@@ -130,14 +151,15 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
 
     root_mask = leaf_id0 == 0
     root_hist = masked_hist(root_mask)
-    root_tot = root_hist[0].sum(axis=0)
+    root_tot = hist_totals(root_hist)
 
-    pool = jnp.zeros((L + 1, G, num_bins, 3), jnp.float32).at[0].set(root_hist)
+    pool_dtype = jnp.int32 if quantized else jnp.float32
+    pool = jnp.zeros((L + 1, G, num_bins, 3), pool_dtype).at[0].set(root_hist)
     totals = jnp.zeros((L + 1, 3), jnp.float32).at[0].set(root_tot)
     depth = jnp.zeros(L + 1, jnp.int32)
     leaf_best = jnp.full((L + 1, REC), neg_inf, jnp.float32)
-    root_rec = guard(find_best_split(root_hist, root_tot, meta, params,
-                                     feature_mask),
+    root_rec = guard(find_best_split(scan_hist(root_hist), root_tot, meta,
+                                     params, feature_mask),
                      root_tot[2], root_tot[1], jnp.int32(0))
     leaf_best = leaf_best.at[0].set(root_rec)
     rec_store = jnp.zeros((max(L - 1, 1), STORE), jnp.float32)
@@ -164,14 +186,14 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
 
         left_hist = masked_hist(on_leaf & go_left)
         right_hist = pool[best_leaf] - left_hist
-        ltot = left_hist[0].sum(axis=0)
+        ltot = hist_totals(left_hist)
         rtot = totals[best_leaf] - ltot
         ndepth = depth[best_leaf] + 1
-        lrec = guard(find_best_split(left_hist, ltot, meta, params,
+        lrec = guard(find_best_split(scan_hist(left_hist), ltot, meta, params,
                                      feature_mask),
                      ltot[2], ltot[1], ndepth)
-        rrec = guard(find_best_split(right_hist, rtot, meta, params,
-                                     feature_mask),
+        rrec = guard(find_best_split(scan_hist(right_hist), rtot, meta,
+                                     params, feature_mask),
                      rtot[2], rtot[1], ndepth)
 
         # parent output for the tree's internal_value bookkeeping
@@ -245,12 +267,15 @@ class DeviceTreeLearner(SerialTreeLearner):
         cfg = self.config
         num_leaves = cfg.num_leaves
         tree = Tree(num_leaves)
+        if self.quantized:
+            gh_ext = self._prepare_gh(gh_ext)  # int8 rows + scales
         gh = gh_ext[:-1]
         if bag_indices is not None:
             in_bag = np.zeros(self.num_data, dtype=bool)
             in_bag[np.asarray(bag_indices, dtype=np.int64)] = True
             leaf_id0 = jnp.asarray(np.where(in_bag, 0, -1).astype(np.int32))
-            gh = jnp.where(jnp.asarray(in_bag)[:, None], gh, 0.0)
+            gh = jnp.where(jnp.asarray(in_bag)[:, None], gh,
+                           jnp.zeros((), gh.dtype))
         else:
             leaf_id0 = jnp.zeros(self.num_data, dtype=jnp.int32)
 
@@ -262,7 +287,8 @@ class DeviceTreeLearner(SerialTreeLearner):
             rec_store, leaf_id, _ = grow_tree_on_device(
                 self.bins_dev, gh, leaf_id0, self.meta, self.tables,
                 self.params_dev, fmask, num_leaves, self.group_bin_padded,
-                cfg.max_depth)
+                cfg.max_depth, quantized=self.quantized,
+                scale_vec=self._scale_vec)
             rec_np = np.asarray(rec_store)  # the one transfer per tree
 
         counts: Dict[int, int] = {0: int(self.num_data if bag_indices is None
@@ -292,6 +318,10 @@ class DeviceTreeLearner(SerialTreeLearner):
         self.partition = DevicePartition(leaf_id, counts)
         if tree.num_leaves == 1:
             tree.as_constant_tree(0.0)
+        elif self.quantized and cfg.quant_train_renew_leaf:
+            # true-gradient renewal; no frontier bounds here (the factory
+            # routes monotone-constrained configs to the host-driven learner)
+            self._renew_quantized_leaves(tree, {})
         return tree
 
 

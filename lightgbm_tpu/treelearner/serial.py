@@ -558,7 +558,6 @@ def create_tree_learner(learner_type: str, device_type: str, config: Config,
                               and any(dataset.monotone_constraints))
                       or CEGB.enabled(config)
                       or config.linear_tree
-                      or config.use_quantized_grad
                       or bool(config.forcedsplits_filename))
         if (device_type != "cpu" and on_accelerator and not has_cat
                 and not needs_host

@@ -88,3 +88,20 @@ def test_device_stops_on_no_gain(rng):
     # first tree fits the mean; second should find nothing
     stop2 = bst.train_one_iter()
     assert stop or stop2
+
+
+def test_device_learner_quantized_matches_serial_quantized(rng):
+    """Quantized int8/int32 path in the fori_loop learner: identical int
+    gradients (same PRNG seed + call order) must reproduce the serial
+    quantized learner's trees exactly."""
+    n = 1500
+    X = rng.randn(n, 6)
+    y = (X[:, 0] - 0.5 * X[:, 1] + rng.randn(n) * 0.3 > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "use_quantized_grad": True, "quant_train_renew_leaf": True}
+    serial_b, device_b = _boosters(X, y, params, 8)
+    p_serial = serial_b.predict(X)
+    p_device = device_b.predict(X)
+    np.testing.assert_allclose(p_device, p_serial, rtol=1e-4, atol=1e-5)
+    acc = np.mean((p_device > 0.5) == y)
+    assert acc > 0.9, acc

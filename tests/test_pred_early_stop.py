@@ -14,11 +14,11 @@ def test_binary_early_stop_margin(rng):
     full = bst.predict(X, raw_score=True)
     es = bst.predict(X, raw_score=True, pred_early_stop=True,
                      pred_early_stop_freq=5, pred_early_stop_margin=2.0)
-    # stopped rows have |raw| already past the margin; agreement on sign
+    # stopped rows have 2*|raw| past the margin (prediction_early_stop.cpp:65)
     assert np.mean(np.sign(es) == np.sign(full)) > 0.99
     stopped = np.abs(es - full) > 1e-9
     assert stopped.any()  # early stop actually kicked in
-    assert np.all(np.abs(es[stopped]) > 2.0)
+    assert np.all(2.0 * np.abs(es[stopped]) > 2.0)
     # huge margin => identical to full prediction
     same = bst.predict(X, raw_score=True, pred_early_stop=True,
                        pred_early_stop_margin=1e9)
