@@ -142,6 +142,7 @@ def main() -> None:
     n_rows = N_ROWS
     last_err = ""
     min_rows = min(50_000, N_ROWS)
+    pallas_fallback_done = False
     while n_rows >= min_rows:
         try:
             res = run_bench(n_rows)
@@ -155,6 +156,18 @@ def main() -> None:
             return
         except Exception as e:  # noqa: BLE001 - degrade, don't crash
             last_err = repr(e)[:400]
+            if (not pallas_fallback_done
+                    and ("osaic" in last_err or "pallas" in last_err
+                         or "Pallas" in last_err)):
+                # unproven-on-this-backend Pallas kernel: fall back to the
+                # XLA histogram path and retry at full size
+                pallas_fallback_done = True
+                record["hist_backend_fallback"] = "xla"
+                os.environ["LGBM_TPU_HIST"] = "xla"
+                import jax
+
+                jax.clear_caches()
+                continue
             oom = "RESOURCE_EXHAUSTED" in last_err or "Out of memory" in last_err
             n_rows //= 4
             if not oom and n_rows < N_ROWS // 16:

@@ -102,6 +102,9 @@ class Metadata:
             ns = len(self.init_score) // max(self.num_data, 1)
             mat = self.init_score.reshape(ns, self.num_data)
             out.init_score = mat[:, indices].ravel()
+        if self.positions is not None:
+            out.positions = self.positions[indices]
+            out.position_ids = self.position_ids
         # query structure is not preserved under arbitrary row subsets
         out._update_query_weights()
         return out

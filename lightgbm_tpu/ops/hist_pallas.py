@@ -1,0 +1,90 @@
+"""Pallas TPU histogram kernel: one-hot stays in VMEM.
+
+The XLA formulation in ops/histogram.py materializes the [G, chunk, B]
+one-hot operand of the contraction unless XLA fuses it into the dot; at
+HIGGS scale (N=10.5M, B=256) a materialized one-hot costs G*N*B*4 bytes of
+HBM traffic per histogram — catastrophically bandwidth-bound. This kernel
+generates each [TN, B] one-hot tile INSIDE the kernel (VMEM-resident, never
+touches HBM) and feeds the MXU directly, so HBM traffic drops to the
+irreducible G*N*(bins + gh) bytes:
+
+    grid (G, N/TN); per step:
+        onehot[TN, B] = (bins_tile[:, None] == iota)      # VPU, VMEM only
+        out[g] += onehot^T @ gh_tile                      # MXU, [B, 3]
+
+The output block for group g is revisited across the N tiles (TPU grids run
+sequentially), accumulating in VMEM; step 0 zero-initializes.
+
+Counterpart of the CUDA shared-memory scatter kernels
+(src/treelearner/cuda/cuda_histogram_constructor.cu:20-513) — same
+"accumulate in fast memory, flush once" structure, with the TPU twist that
+the accumulation is an MXU contraction instead of atomic scatters.
+
+Used automatically on TPU backends (ops/histogram.py routes here); the XLA
+path remains for CPU and as the LGBM_TPU_HIST=xla escape hatch. Correctness
+is pinned by tests running this kernel in interpret mode against the XLA
+path and the numpy reference.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_TILE_ROWS = 2048
+
+
+def _make_kernel(num_bins: int, tile_rows: int, compute_dtype, acc_dtype):
+    def kernel(bins_ref, gh_ref, out_ref):
+        @pl.when(pl.program_id(1) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        b = bins_ref[0, :]  # [TN] int32
+        iota = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, num_bins), 1)
+        onehot = (b[:, None] == iota).astype(compute_dtype)  # VMEM only
+        acc = jax.lax.dot_general(
+            onehot, gh_ref[...].astype(compute_dtype),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=acc_dtype)  # [B, CH]
+        out_ref[0] += acc
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("num_bins", "tile_rows", "quantized",
+                                   "interpret"))
+def pallas_histogram(bins: jax.Array, gh: jax.Array, num_bins: int,
+                     tile_rows: int = DEFAULT_TILE_ROWS,
+                     quantized: bool = False,
+                     interpret: bool = False) -> jax.Array:
+    """[G, N] bins + [N, CH] gh -> [G, num_bins, CH] histogram.
+
+    quantized: int8 one-hot x int8 gh with exact int32 accumulation
+    (MXU-native); otherwise f32 throughout. Rows are padded to the tile
+    size with zero gh (contributes nothing).
+    """
+    G, N = bins.shape
+    CH = gh.shape[1]
+    compute_dtype = jnp.int8 if quantized else jnp.float32
+    acc_dtype = jnp.int32 if quantized else jnp.float32
+    n_tiles = max(-(-N // tile_rows), 1)
+    pad = n_tiles * tile_rows - N
+    bins = bins.astype(jnp.int32)
+    if pad:
+        bins = jnp.pad(bins, ((0, 0), (0, pad)), constant_values=0)
+        gh = jnp.pad(gh, ((0, pad), (0, 0)))  # zero gh => no contribution
+    out = pl.pallas_call(
+        _make_kernel(num_bins, tile_rows, compute_dtype, acc_dtype),
+        grid=(G, n_tiles),
+        in_specs=[
+            pl.BlockSpec((1, tile_rows), lambda g, t: (g, t)),
+            pl.BlockSpec((tile_rows, CH), lambda g, t: (t, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, num_bins, CH), lambda g, t: (g, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((G, num_bins, CH), acc_dtype),
+        interpret=interpret,
+    )(bins, gh)
+    return out

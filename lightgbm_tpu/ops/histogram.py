@@ -23,6 +23,7 @@ The channel layout is [grad, hess, count].
 """
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional
 
@@ -30,6 +31,25 @@ import jax
 import jax.numpy as jnp
 
 DEFAULT_ROW_CHUNK = 16384
+
+
+def _use_pallas() -> bool:
+    """Pallas kernel on TPU ONLY (the XLA one-hot contraction risks
+    materializing the [G, chunk, B] one-hot in HBM); the kernel's
+    revisited-output accumulation relies on TPU's sequential grid, so other
+    backends (cpu, gpu) always take the XLA path. LGBM_TPU_HIST=xla|pallas
+    overrides, resolved at CALL time (the public entry points are unjitted
+    wrappers so the env var participates in dispatch, not a baked trace)."""
+    mode = os.environ.get("LGBM_TPU_HIST", "auto")
+    if mode == "xla":
+        return False
+    if mode == "pallas":
+        return True
+    try:
+        backend = jax.default_backend().lower()
+        return backend == "tpu" or "tpu" in backend or "axon" in backend
+    except RuntimeError:
+        return False
 
 
 def _acc_dtype(compute_dtype):
@@ -52,16 +72,36 @@ def _hist_chunk(bins_c: jax.Array, gh_c: jax.Array, num_bins: int,
     )  # [G, B, 3]
 
 
-@partial(jax.jit, static_argnames=("num_bins", "row_chunk", "compute_dtype"))
 def build_histogram(bins: jax.Array, gh: jax.Array, num_bins: int,
                     row_chunk: int = DEFAULT_ROW_CHUNK,
-                    compute_dtype=jnp.float32) -> jax.Array:
+                    compute_dtype=jnp.float32,
+                    use_pallas: bool = None) -> jax.Array:
     """Full-data histogram.
 
     bins: [G, N] integer bin matrix (any int dtype)
     gh:   [N, 3] float (grad, hess, 1.0)
     Returns [G, num_bins, 3] float32.
+
+    Unjitted dispatch wrapper: the backend choice (Pallas on TPU, XLA
+    elsewhere / LGBM_TPU_HIST override) resolves per call, then routes to a
+    jitted implementation. Inside an outer jit the choice is baked at that
+    trace's creation, as any Python-level branch must be.
     """
+    if use_pallas is None:
+        use_pallas = _use_pallas()
+    if use_pallas:
+        from .hist_pallas import pallas_histogram
+
+        return pallas_histogram(
+            bins.astype(jnp.int32), gh, num_bins,
+            quantized=jnp.issubdtype(jnp.dtype(compute_dtype), jnp.integer))
+    return _build_histogram_xla(bins, gh, num_bins, row_chunk, compute_dtype)
+
+
+@partial(jax.jit, static_argnames=("num_bins", "row_chunk", "compute_dtype"))
+def _build_histogram_xla(bins: jax.Array, gh: jax.Array, num_bins: int,
+                         row_chunk: int = DEFAULT_ROW_CHUNK,
+                         compute_dtype=jnp.float32) -> jax.Array:
     G, N = bins.shape
     bins = bins.astype(jnp.int32)
     if N <= row_chunk:
@@ -83,11 +123,12 @@ def build_histogram(bins: jax.Array, gh: jax.Array, num_bins: int,
     return hist
 
 
-@partial(jax.jit, static_argnames=("num_bins", "row_chunk", "compute_dtype"))
 def build_histogram_rows(bins: jax.Array, gh_ext: jax.Array, row_idx: jax.Array,
                          num_bins: int, row_chunk: int = DEFAULT_ROW_CHUNK,
-                         compute_dtype=jnp.float32) -> jax.Array:
-    """Leaf histogram over a padded row-index set.
+                         compute_dtype=jnp.float32,
+                         use_pallas: bool = None) -> jax.Array:
+    """Leaf histogram over a padded row-index set (unjitted dispatch wrapper
+    like build_histogram).
 
     bins:    [G, N] full bin matrix
     gh_ext:  [N+1, 3] gradients with a ZERO sentinel row at index N
@@ -97,6 +138,27 @@ def build_histogram_rows(bins: jax.Array, gh_ext: jax.Array, row_idx: jax.Array,
     Padded entries gather gh == 0 so they contribute nothing; their bins
     gather is clamped (any bin works since the weight is zero).
     """
+    if use_pallas is None:
+        use_pallas = _use_pallas()
+    if use_pallas:
+        from .hist_pallas import pallas_histogram
+
+        G, N = bins.shape
+        bins_leaf = jnp.take(bins, jnp.minimum(row_idx, N - 1),
+                             axis=1).astype(jnp.int32)
+        gh_leaf = jnp.take(gh_ext, row_idx, axis=0)
+        return pallas_histogram(
+            bins_leaf, gh_leaf, num_bins,
+            quantized=jnp.issubdtype(jnp.dtype(compute_dtype), jnp.integer))
+    return _build_histogram_rows_xla(bins, gh_ext, row_idx, num_bins,
+                                     row_chunk, compute_dtype)
+
+
+@partial(jax.jit, static_argnames=("num_bins", "row_chunk", "compute_dtype"))
+def _build_histogram_rows_xla(bins: jax.Array, gh_ext: jax.Array,
+                              row_idx: jax.Array, num_bins: int,
+                              row_chunk: int = DEFAULT_ROW_CHUNK,
+                              compute_dtype=jnp.float32) -> jax.Array:
     G, N = bins.shape
     bins_leaf = jnp.take(bins, jnp.minimum(row_idx, N - 1), axis=1).astype(jnp.int32)
     gh_leaf = jnp.take(gh_ext, row_idx, axis=0)  # idx==N hits the zero row

@@ -110,6 +110,11 @@ def _tree_shap(tree: Tree, row: np.ndarray, phi: np.ndarray) -> None:
 def predict_contrib(trees: List[Tree], X: np.ndarray,
                     num_tree_per_iteration: int = 1,
                     num_iteration: int = 0) -> np.ndarray:
+    if any(t.is_linear for t in trees):
+        # matches the reference: TreeSHAP is undefined over linear leaf
+        # models (gbdt.cpp PredictContrib path CHECKs !linear_tree_)
+        raise ValueError(
+            "pred_contrib (SHAP) is not supported for linear-tree models")
     n, f = X.shape
     n_trees = len(trees)
     if num_iteration > 0:

@@ -319,10 +319,26 @@ class DeviceTreeLearner(SerialTreeLearner):
         if tree.num_leaves == 1:
             tree.as_constant_tree(0.0)
         elif self.quantized and cfg.quant_train_renew_leaf:
-            # true-gradient renewal; no frontier bounds here (the factory
-            # routes monotone-constrained configs to the host-driven learner)
-            self._renew_quantized_leaves(tree, {})
+            self._renew_quantized_leaves_device(tree, leaf_id)
         return tree
+
+    def _renew_quantized_leaves_device(self, tree: Tree,
+                                       leaf_id: jax.Array) -> None:
+        """True-gradient leaf renewal in ONE scatter-add dispatch over the
+        on-device leaf-id vector (no per-leaf host scans; no frontier bounds
+        here — the factory routes monotone configs to the host learner)."""
+        cfg = self.config
+        L = tree.num_leaves
+        ghf = self._gh_float[:-1, :2]
+        ids = jnp.where(leaf_id >= 0, leaf_id, L)  # bagged-out -> dump row
+        sums = np.asarray(
+            jnp.zeros((L + 1, 2), jnp.float32).at[ids].add(ghf))
+        for leaf in range(L):
+            out = _leaf_output_host(float(sums[leaf, 0]),
+                                    float(sums[leaf, 1]),
+                                    cfg.lambda_l1, cfg.lambda_l2,
+                                    cfg.max_delta_step)
+            tree.set_leaf_output(leaf, out)
 
 
 def pool_bytes(num_leaves: int, num_groups: int, num_bins: int) -> int:

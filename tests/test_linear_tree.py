@@ -65,3 +65,14 @@ def test_linear_tree_l1_fatal(rng):
         lgb.train({"objective": "regression_l1", "linear_tree": True,
                    "verbosity": -1}, lgb.Dataset(X, label=y),
                   num_boost_round=1)
+
+
+def test_linear_tree_shap_unsupported(rng):
+    X, y = _piecewise_linear(rng, n=500)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "linear_tree": True, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    import pytest
+
+    with pytest.raises(ValueError, match="linear"):
+        bst.predict(X, pred_contrib=True)
