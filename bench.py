@@ -167,6 +167,7 @@ def main() -> None:
                 import jax
 
                 jax.clear_caches()
+                n_rows = N_ROWS  # retry the XLA path at full size
                 continue
             oom = "RESOURCE_EXHAUSTED" in last_err or "Out of memory" in last_err
             n_rows //= 4

@@ -422,26 +422,34 @@ class Booster:
                 **kwargs) -> np.ndarray:
         X = _to_2d_float(data).astype(np.float32)
         if num_iteration is None:
-            num_iteration = self.best_iteration if self.best_iteration > 0 else 0
+            # best-iteration truncation applies to whole-model predicts only;
+            # an explicit start_iteration means "this slice onward"
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0
+                             and start_iteration <= 0 else 0)
         if pred_leaf:
-            return self._gbdt.predict_leaf_index(X, num_iteration)
+            return self._gbdt.predict_leaf_index(X, num_iteration,
+                                                 start_iteration)
         if pred_contrib:
             from .shap import predict_contrib
 
-            return predict_contrib(self._gbdt.models, X,
-                                   self._gbdt.num_tree_per_iteration,
-                                   num_iteration)
+            C = self._gbdt.num_tree_per_iteration
+            trees = self._gbdt.models[max(start_iteration, 0) * C:]
+            return predict_contrib(trees, X, C, num_iteration)
         if param_bool(kwargs.get("pred_early_stop",
                                  self.params.get("pred_early_stop"))):
             return self._gbdt.predict(
                 X, raw_score=raw_score, num_iteration=num_iteration,
+                start_iteration=start_iteration,
                 early_stop=(
                     int(kwargs.get("pred_early_stop_freq",
                                    self.params.get("pred_early_stop_freq", 10))),
                     float(kwargs.get(
                         "pred_early_stop_margin",
                         self.params.get("pred_early_stop_margin", 10.0)))))
-        return self._gbdt.predict(X, raw_score=raw_score, num_iteration=num_iteration)
+        return self._gbdt.predict(X, raw_score=raw_score,
+                                  num_iteration=num_iteration,
+                                  start_iteration=start_iteration)
 
     # ------------------------------------------------------------------ model
 

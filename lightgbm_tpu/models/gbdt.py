@@ -353,19 +353,22 @@ class GBDT:
 
     # ---------------------------------------------------------------- predict
 
-    def _packed(self, num_iteration: int = 0):
+    def _packed(self, num_iteration: int = 0, start_iteration: int = 0):
+        C = self.num_tree_per_iteration
+        start = max(start_iteration, 0) * C
         n_trees = len(self.models)
         if num_iteration > 0:
-            n_trees = min(n_trees, num_iteration * self.num_tree_per_iteration)
-        key = n_trees
+            n_trees = min(n_trees, start + num_iteration * C)
+        key = (start, n_trees)
         if self._packed_cache is None or self._packed_cache[0] != key:
-            self._packed_cache = (key, pack_ensemble(self.models[:n_trees]))
+            self._packed_cache = (key,
+                                  pack_ensemble(self.models[start:n_trees]))
         return self._packed_cache[1]
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
-                num_iteration: int = 0,
+                num_iteration: int = 0, start_iteration: int = 0,
                 early_stop: Optional[Tuple[int, float]] = None) -> np.ndarray:
-        packed = self._packed(num_iteration)
+        packed = self._packed(num_iteration, start_iteration)
         if early_stop is not None and packed.num_trees > 0:
             from ..ops.predict import predict_raw_early_stop
 
@@ -383,10 +386,11 @@ class GBDT:
         res = np.asarray(out)
         return res[:, 0] if res.shape[1] == 1 else res
 
-    def predict_leaf_index(self, X: np.ndarray, num_iteration: int = 0) -> np.ndarray:
+    def predict_leaf_index(self, X: np.ndarray, num_iteration: int = 0,
+                           start_iteration: int = 0) -> np.ndarray:
         from ..ops.predict import predict_leaf_indices
 
-        packed = self._packed(num_iteration)
+        packed = self._packed(num_iteration, start_iteration)
         return np.asarray(predict_leaf_indices(packed, jnp.asarray(X, dtype=jnp.float32)))
 
     # ------------------------------------------------------------------ model

@@ -47,7 +47,7 @@ def _use_pallas() -> bool:
         return True
     try:
         backend = jax.default_backend().lower()
-        return backend == "tpu" or "tpu" in backend or "axon" in backend
+        return "tpu" in backend or "axon" in backend
     except RuntimeError:
         return False
 

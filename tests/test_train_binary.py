@@ -126,3 +126,20 @@ def test_custom_objective_fobj():
     raw = bst2.predict(X, raw_score=True)
     auc = auc_np(y, raw)
     assert auc > 0.95
+
+
+def test_predict_start_iteration(rng):
+    """start_iteration slices the ensemble (Booster.predict parity with
+    python-package predict(start_iteration=...))."""
+    X = rng.randn(800, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=6)
+    full = bst.predict(X, raw_score=True)
+    head = bst.predict(X, raw_score=True, num_iteration=2)
+    tail = bst.predict(X, raw_score=True, start_iteration=2)
+    np.testing.assert_allclose(head + tail, full, rtol=1e-5, atol=1e-6)
+    mid = bst.predict(X, raw_score=True, start_iteration=2, num_iteration=2)
+    last = bst.predict(X, raw_score=True, start_iteration=4)
+    np.testing.assert_allclose(head + mid + last, full, rtol=1e-5, atol=1e-6)
