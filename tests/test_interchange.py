@@ -75,3 +75,70 @@ def test_our_model_keeps_reference_fields(tmp_path):
                   "leaf_value=", "cat_boundaries=", "cat_threshold=",
                   "shrinkage=", "end of trees"):
         assert field in text, f"missing reference model field: {field}"
+
+
+@pytest.mark.skipif(not os.path.isdir("/root/reference/examples"),
+                    reason="reference examples not available")
+def test_load_reference_lambdarank_model():
+    """Ranking-model interchange: the reference-trained lambdarank model
+    (8 trees on examples/lambdarank) loads and reproduces the reference's
+    own raw predictions on rank.test."""
+    from lightgbm_tpu.io.parser import parse_file
+
+    X, _, _ = parse_file("/root/reference/examples/lambdarank/rank.test")
+    ref_pred = np.loadtxt(os.path.join(FIXTURES, "rank.pred.txt"))
+    bst = lgb.Booster(model_file=os.path.join(FIXTURES, "rank.model.txt"))
+    pred = bst.predict(X)
+    np.testing.assert_allclose(pred, ref_pred, rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.skipif(not os.path.isdir("/root/reference/examples"),
+                    reason="reference examples not available")
+def test_load_reference_multiclass_model():
+    """Multiclass interchange (num_tree_per_iteration=5 softmax packing)."""
+    from lightgbm_tpu.io.parser import parse_file
+
+    X, _, _ = parse_file(
+        "/root/reference/examples/multiclass_classification/multiclass.test")
+    ref_pred = np.loadtxt(os.path.join(FIXTURES, "multiclass.pred.txt"))
+    bst = lgb.Booster(model_file=os.path.join(FIXTURES,
+                                              "multiclass.model.txt"))
+    pred = bst.predict(X)
+    assert pred.shape == ref_pred.shape
+    # a handful of rows sit exactly on split thresholds where f32 device
+    # inference and the reference's f64 traversal legitimately disagree;
+    # demand near-total elementwise agreement instead of exactness
+    close = np.isclose(pred, ref_pred, rtol=5e-5, atol=5e-6)
+    assert close.mean() > 0.995, close.mean()
+    assert np.abs(pred - ref_pred).mean() < 1e-4
+
+
+REF_BIN = "/tmp/refsrc/lightgbm"
+
+
+@pytest.mark.skipif(not os.path.exists(REF_BIN),
+                    reason="reference binary not built (see memory notes: "
+                           "cp -r /root/reference /tmp/refsrc + stubs)")
+def test_reference_binary_loads_our_model(tmp_path):
+    """Reverse interchange: the REFERENCE LightGBM binary loads a model we
+    saved and reproduces our predictions."""
+    import subprocess
+
+    X, y = _load_fixture_data()
+    ds = lgb.Dataset(X, label=y, categorical_feature=[3])
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, ds, num_boost_round=6)
+    model_path = str(tmp_path / "ours.txt")
+    bst.save_model(model_path)
+    conf = tmp_path / "pred.conf"
+    out_path = str(tmp_path / "ref_pred.txt")
+    conf.write_text(
+        "task = predict\n"
+        f"data = {os.path.join(FIXTURES, 'interchange.train')}\n"
+        f"input_model = {model_path}\n"
+        f"output_result = {out_path}\n")
+    subprocess.run([REF_BIN, f"config={conf}"], check=True,
+                   capture_output=True, timeout=300)
+    ref_on_ours = np.loadtxt(out_path)
+    np.testing.assert_allclose(ref_on_ours, bst.predict(X), rtol=2e-5,
+                               atol=2e-6)
