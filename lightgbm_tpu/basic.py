@@ -30,10 +30,46 @@ from .utils.log import Log, LightGBMError
 _NUMERIC_TYPES = (int, float, bool)
 
 
+def _is_dataframe(data) -> bool:
+    return hasattr(data, "dtypes") and hasattr(data, "columns")
+
+
+def _pandas_to_matrix(df, pandas_categorical=None):
+    """DataFrame -> (float64 matrix, categorical column indices,
+    per-column category lists). Category-dtype columns become integer codes
+    (NaN for missing/unseen); with `pandas_categorical` supplied (predict
+    time), values map through the TRAINING categories — the python-package
+    _data_from_pandas / pandas_categorical protocol."""
+    cat_cols = [i for i, dt in enumerate(df.dtypes)
+                if str(dt) == "category"]
+    if pandas_categorical is not None and \
+            len(cat_cols) != len(pandas_categorical):
+        raise ValueError(
+            "train and valid dataset categorical_feature do not match")
+    if not cat_cols:
+        return np.asarray(df, dtype=np.float64), [], None
+    df = df.copy(deep=False)
+    cats_out = []
+    for k, i in enumerate(cat_cols):
+        col = df.iloc[:, i]
+        if pandas_categorical is not None:
+            cats = list(pandas_categorical[k])
+            col = col.cat.set_categories(cats)
+        else:
+            cats = list(col.cat.categories)
+        cats_out.append(cats)
+        codes = col.cat.codes.to_numpy(dtype=np.float64, copy=True)
+        codes[codes < 0] = np.nan  # missing / unseen categories
+        df.isetitem(i, codes)
+    return np.asarray(df, dtype=np.float64), cat_cols, cats_out
+
+
 def _to_2d_float(data) -> np.ndarray:
     if hasattr(data, "toarray"):  # scipy sparse
         data = data.toarray()
-    if hasattr(data, "values") and not isinstance(data, np.ndarray):  # DataFrame
+    if _is_dataframe(data):
+        data = _pandas_to_matrix(data)[0]
+    elif hasattr(data, "values") and not isinstance(data, np.ndarray):
         data = data.values
     arr = np.asarray(data, dtype=np.float64)
     if arr.ndim == 1:
@@ -112,10 +148,21 @@ class Dataset:
             p = load_positions(str(data))
             if p is not None and self.position is None:
                 self.position = p
+        elif _is_dataframe(data):
+            # validation frames must encode through the TRAINING set's
+            # category lists, not their own inferred order
+            ref_pc = None
+            if self.reference is not None:
+                self.reference.construct()
+                ref_pc = self.reference.pandas_categorical
+            X, pd_cat_cols, pd_cats = _pandas_to_matrix(data, ref_pc)
+            self.pandas_categorical = ref_pc if ref_pc is not None else pd_cats
+            if self.feature_name == "auto":
+                feature_names = [str(c) for c in data.columns]
+            if pd_cat_cols and self.categorical_feature == "auto":
+                self.categorical_feature = pd_cat_cols
         else:
             X = _to_2d_float(data)
-            if (self.feature_name == "auto" and hasattr(data, "columns")):
-                feature_names = [str(c) for c in data.columns]
 
         if isinstance(self.feature_name, (list, tuple)):
             feature_names = list(self.feature_name)
@@ -299,6 +346,7 @@ class Booster:
             self._gbdt = create_boosting(self.config, train_set._handle,
                                          objective, train_raw=train_set._raw)
             self.train_set = train_set
+            self.pandas_categorical = train_set.pandas_categorical
             self._model: Optional[GBDTModel] = None
         elif model_file is not None or model_str is not None:
             model = (GBDTModel.from_file(model_file) if model_file
@@ -312,7 +360,7 @@ class Booster:
             self._gbdt.objective = _objective_from_string(model.objective_str, self.config)
             self._gbdt.average_output = model.average_output
             self.train_set = None
-            self.pandas_categorical = None
+            self.pandas_categorical = model.pandas_categorical
         else:
             raise TypeError("Need at least one training dataset or model "
                             "file or model string to create Booster instance")
@@ -420,6 +468,8 @@ class Booster:
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, validate_features: bool = False,
                 **kwargs) -> np.ndarray:
+        if _is_dataframe(data) and self.pandas_categorical:
+            data = _pandas_to_matrix(data, self.pandas_categorical)[0]
         X = _to_2d_float(data).astype(np.float32)
         if num_iteration is None:
             # best-iteration truncation applies to whole-model predicts only;
@@ -484,6 +534,7 @@ class Booster:
         if self.train_set is not None:
             model = self._gbdt.to_model()
             model.best_iteration = self.best_iteration
+            model.pandas_categorical = self.pandas_categorical
             return model
         return self._model
 

@@ -74,6 +74,12 @@ def _task_train(config: Config, params: Dict[str, str]) -> int:
     if not config.data:
         Log.fatal("No training data, please set data=... in the config")
     train_ds = Dataset(config.data, params=params)
+    if config.save_binary:
+        # is_save_binary_file: persist the constructed dataset cache next to
+        # the text file (application.cpp LoadData -> SaveBinaryFile)
+        train_ds.construct()
+        train_ds.save_binary(str(config.data) + ".bin")
+        Log.info("Saved binary dataset cache to %s.bin", config.data)
     valid_sets = []
     valid_names = []
     valid_paths = config.valid if isinstance(config.valid, list) else (

@@ -36,6 +36,9 @@ class GBDTModel:
         self.best_iteration = 0
         self.parameters_str = ""  # `parameters:` section payload
         self.loaded_parameters = ""  # params recovered from a loaded file
+        # per-categorical-column pandas category lists (python-package
+        # appends `pandas_categorical:<json>` after the parameters section)
+        self.pandas_categorical = None
 
     # ------------------------------------------------------------- properties
 
@@ -108,6 +111,9 @@ class GBDTModel:
         params = self.parameters_str or self.loaded_parameters
         if params:
             out += "\nparameters:\n" + params + "\nend of parameters\n"
+        if self.pandas_categorical is not None:
+            out += ("pandas_categorical:"
+                    + json.dumps(self.pandas_categorical, default=str) + "\n")
         return out
 
     def save_to_file(self, filename: str, start_iteration: int = 0,
@@ -178,6 +184,16 @@ class GBDTModel:
             end = text.find("end of parameters", start)
             if end >= 0:
                 model.loaded_parameters = text[start:end].strip()
+        # python-package pandas category lists (trailing json line)
+        marker = "pandas_categorical:"
+        pos = text.rfind("\n" + marker)
+        if pos >= 0:
+            line = text[pos + 1 + len(marker):].splitlines()[0].strip()
+            if line and line != "null":
+                try:
+                    model.pandas_categorical = json.loads(line)
+                except ValueError:
+                    pass
         return model
 
     @classmethod

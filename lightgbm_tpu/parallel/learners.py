@@ -440,7 +440,11 @@ def create_parallel_learner(learner_type: str, config: Config,
 
     # join the multi-host world first when a machine list / env is present,
     # so the mesh below spans every process's devices
-    init_distributed(config)
+    if init_distributed(config) and config.pre_partition:
+        Log.warning(
+            "pre_partition=true is not yet honored: every process must load "
+            "the full dataset (device memory IS stripe-partitioned; host "
+            "memory is replicated)")
     if CEGB.enabled(config):
         Log.fatal("cegb_* parameters are not supported with distributed "
                   "tree learners (use tree_learner=serial)")

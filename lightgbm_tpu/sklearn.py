@@ -14,7 +14,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import numpy as np
 
 from . import callback as callback_mod
-from .basic import Booster, Dataset
+from .basic import Booster, Dataset, _is_dataframe
 from .engine import train as train_fn
 from .utils.log import LightGBMError
 
@@ -129,7 +129,8 @@ class LGBMModel(BaseEstimator):
     def _fit(self, X, y, sample_weight=None, init_score=None, group=None,
              eval_set=None, eval_names=None, eval_sample_weight=None,
              eval_group=None, eval_metric=None, callbacks=None) -> "LGBMModel":
-        X = np.asarray(X, dtype=np.float64)
+        if not _is_dataframe(X):  # DataFrames pass through to Dataset's
+            X = np.asarray(X, dtype=np.float64)  # pandas-categorical handling
         y = np.asarray(y, dtype=np.float64).ravel()
         self._n_features = X.shape[1]
         params = self._lgb_params()
@@ -145,7 +146,8 @@ class LGBMModel(BaseEstimator):
             for i, (vx, vy) in enumerate(eval_set):
                 vw = eval_sample_weight[i] if eval_sample_weight else None
                 vg = eval_group[i] if eval_group else None
-                vx = np.asarray(vx, dtype=np.float64)
+                if not _is_dataframe(vx):
+                    vx = np.asarray(vx, dtype=np.float64)
                 vy = np.asarray(vy, dtype=np.float64).ravel()
 
                 def _opt_equal(a, b):
@@ -185,7 +187,8 @@ class LGBMModel(BaseEstimator):
                 **kwargs: Any) -> np.ndarray:
         if self._Booster is None:
             raise _not_fitted_error(self)
-        X = np.asarray(X, dtype=np.float64)
+        if not _is_dataframe(X):  # frames map through pandas_categorical
+            X = np.asarray(X, dtype=np.float64)
         if X.shape[1] != self._n_features:
             raise ValueError(
                 "Number of features of the model must match the input. "

@@ -2,6 +2,7 @@
 (Dataset::SaveBinaryFile / DatasetLoader::GetForcedBins /
 SerialTreeLearner::ForceSplits)."""
 import json
+import os
 
 import numpy as np
 
@@ -81,3 +82,24 @@ def test_histogram_pool_cap_exact(rng):
     core = CoreDataset.from_matrix(X, label=y, config=cfg)
     learner = SerialTreeLearner(cfg, core)
     assert learner._pool_cap >= 2
+
+
+def test_cli_save_binary_cache(rng, tmp_path):
+    """is_save_binary_file writes a loadable cache next to the data file
+    (application.cpp LoadData -> SaveBinaryFile)."""
+    from lightgbm_tpu import cli
+
+    X, y = _data(rng, n=400)
+    train_path = str(tmp_path / "sb.train")
+    np.savetxt(train_path, np.column_stack([y, X]), delimiter="\t",
+               fmt="%.8g")
+    rc = cli.run([f"data={train_path}", "objective=binary", "num_trees=2",
+                  "num_leaves=7", "is_save_binary_file=true",
+                  f"output_model={tmp_path}/m.txt", "device_type=cpu",
+                  "verbosity=-1"])
+    assert rc == 0
+    cache = train_path + ".bin"
+    assert os.path.exists(cache)
+    ds = lgb.Dataset(cache)
+    ds.construct()
+    assert ds._handle.num_data == 400

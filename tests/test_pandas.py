@@ -1,0 +1,106 @@
+"""Pandas DataFrame handling: category dtype auto-detection, the
+pandas_categorical training->predict mapping, and model-file persistence
+(python-package _data_from_pandas protocol)."""
+import numpy as np
+import pytest
+
+pd = pytest.importorskip("pandas")
+
+import lightgbm_tpu as lgb
+
+
+def _frame(rng, n=800):
+    df = pd.DataFrame({
+        "a": rng.randn(n),
+        "b": pd.Categorical(rng.choice(["x", "y", "z"], n)),
+        "c": rng.randn(n),
+    })
+    y = ((df["a"] + (df["b"] == "x") * 2.0 + rng.randn(n) * 0.3) > 0
+         ).astype(float)
+    return df, y
+
+
+def test_pandas_categorical_training(rng):
+    df, y = _frame(rng)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(df, label=y),
+                    num_boost_round=10)
+    acc = ((bst.predict(df) > 0.5) == y).mean()
+    assert acc > 0.85, acc
+    # the category column must actually be used as categorical
+    dumped = bst.dump_model()
+
+    def has_cat(node):
+        if "split_feature" in node:
+            return (node["decision_type"] == "==" or has_cat(node["left_child"])
+                    or has_cat(node["right_child"]))
+        return False
+
+    assert any(has_cat(t["tree_structure"]) for t in dumped["tree_info"])
+
+
+def test_pandas_categorical_mapping_roundtrip(rng, tmp_path):
+    df, y = _frame(rng)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(df, label=y),
+                    num_boost_round=5)
+    pred = bst.predict(df)
+    # reordered/unseen categories at predict time map through TRAINING codes
+    df2 = df.copy()
+    df2["b"] = pd.Categorical(df["b"].astype(str),
+                              categories=["z", "x", "y", "new"])
+    np.testing.assert_allclose(bst.predict(df2), pred, rtol=1e-6)
+
+    path = str(tmp_path / "pd.txt")
+    bst.save_model(path)
+    assert "pandas_categorical:" in open(path).read()
+    re = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(re.predict(df2), pred, rtol=1e-6)
+
+
+def test_pandas_plain_numeric_frame(rng):
+    df = pd.DataFrame({"a": rng.randn(300), "b": rng.randn(300)})
+    y = (df["a"] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(df, label=y),
+                    num_boost_round=3)
+    assert np.isfinite(bst.predict(df)).all()
+
+
+def test_sklearn_with_pandas_categorical(rng):
+    df, y = _frame(rng, n=600)
+    clf = lgb.LGBMClassifier(n_estimators=8, num_leaves=7, verbosity=-1)
+    clf.fit(df, (y > 0).astype(int))
+    acc = (clf.predict(df) == (y > 0)).mean()
+    assert acc > 0.85, acc
+
+
+def test_valid_set_maps_through_training_categories(rng):
+    """A validation frame whose pandas categories are ordered differently
+    must still encode through the TRAINING category lists."""
+    df, y = _frame(rng, n=600)
+    df_v = df.iloc[:200].copy()
+    y_v = y.iloc[:200]
+    # same values, different category order + an extra unseen category
+    df_v["b"] = pd.Categorical(df_v["b"].astype(str),
+                               categories=["z", "y", "x", "extra"])
+    ds = lgb.Dataset(df, label=y)
+    dv = lgb.Dataset(df_v, label=y_v, reference=ds)
+    rec = {}
+    lgb.train({"objective": "binary", "num_leaves": 7, "metric": "binary_logloss",
+               "verbosity": -1}, ds, num_boost_round=8, valid_sets=[dv],
+              callbacks=[lgb.record_evaluation(rec)])
+    vloss = rec["valid_0"]["binary_logloss"][-1]
+    # with correct mapping the valid loss tracks training (same rows)
+    assert vloss < 0.5, vloss
+
+
+def test_categorical_count_mismatch_raises(rng):
+    df, y = _frame(rng, n=300)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(df, label=y),
+                    num_boost_round=2)
+    bad = df.copy()
+    bad["c"] = pd.Categorical(rng.choice(["u", "v"], len(df)))  # extra cat col
+    with pytest.raises(ValueError, match="categorical_feature"):
+        bst.predict(bad)
