@@ -42,6 +42,21 @@ def _pandas_to_matrix(df, pandas_categorical=None):
     _data_from_pandas / pandas_categorical protocol."""
     cat_cols = [i for i, dt in enumerate(df.dtypes)
                 if str(dt) == "category"]
+    def _numeric(dt) -> bool:
+        try:
+            return bool(np.issubdtype(dt, np.number)
+                        or np.issubdtype(dt, np.bool_))
+        except TypeError:  # pandas extension dtype (nullable/datetime/...)
+            return False
+
+    bad = [str(df.columns[i]) for i, dt in enumerate(df.dtypes)
+           if i not in cat_cols and not _numeric(dt)]
+    if bad:  # the python-package's explicit bad-dtype message (basic.py
+        # _data_from_pandas), not an opaque numpy cast error
+        raise ValueError(
+            "DataFrame.dtypes for data must be int, float or bool. Did not "
+            "expect the data types in the following fields: "
+            + ", ".join(bad))
     if pandas_categorical is not None and \
             len(cat_cols) != len(pandas_categorical):
         raise ValueError(

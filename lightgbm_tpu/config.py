@@ -272,6 +272,14 @@ class Config:
         self._post_process()
 
     def _post_process(self) -> None:
+        # The reference's device_type default is "cpu" (it IS a CPU library,
+        # config.h:690); defaulting a TPU-native framework to the host path
+        # would leave the attached accelerator idle. Unset device_type means
+        # "auto": the tree-learner factory picks the on-device learner when
+        # an accelerator backend is live. An EXPLICIT device_type=cpu (or
+        # device=cpu alias) still forces the host-driven path.
+        if "device_type" not in self.raw_params:
+            self.device_type = "auto"
         # mirrors Config::CheckParamConflict essentials
         if self.is_unbalance and self.scale_pos_weight != 1.0:
             Log.fatal("Cannot set both is_unbalance and scale_pos_weight, choose only one of them")

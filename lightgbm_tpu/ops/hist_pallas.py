@@ -8,12 +8,15 @@ generates each [TN, B] one-hot tile INSIDE the kernel (VMEM-resident, never
 touches HBM) and feeds the MXU directly, so HBM traffic drops to the
 irreducible G*N*(bins + gh) bytes:
 
-    grid (G, N/TN); per step:
-        onehot[TN, B] = (bins_tile[:, None] == iota)      # VPU, VMEM only
+    grid (G/8, N/TN); per step, for each of the 8 groups in the block:
+        onehot[TN, B] = (bins_tile[g][:, None] == iota)   # VPU, VMEM only
         out[g] += onehot^T @ gh_tile                      # MXU, [B, 3]
 
-The output block for group g is revisited across the N tiles (TPU grids run
-sequentially), accumulating in VMEM; step 0 zero-initializes.
+Groups are blocked by 8 because Mosaic requires the second-to-last block
+dim to be a multiple of 8 (or the full array dim) — a (1, TN) bins block
+fails to lower on real TPU hardware. The output block for a group-8 slab is
+revisited across the N tiles (TPU grids run sequentially), accumulating in
+VMEM; step 0 zero-initializes.
 
 Counterpart of the CUDA shared-memory scatter kernels
 (src/treelearner/cuda/cuda_histogram_constructor.cu:20-513) — same
@@ -27,6 +30,7 @@ path and the numpy reference.
 """
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -34,6 +38,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 DEFAULT_TILE_ROWS = 2048
+GROUP_BLOCK = 8  # Mosaic minimum for the second-to-last block dim
 
 
 def _make_kernel(num_bins: int, tile_rows: int, compute_dtype, acc_dtype):
@@ -42,49 +47,85 @@ def _make_kernel(num_bins: int, tile_rows: int, compute_dtype, acc_dtype):
         def _init():
             out_ref[...] = jnp.zeros_like(out_ref)
 
-        b = bins_ref[0, :]  # [TN] int32
+        gh = gh_ref[...].astype(compute_dtype)
         iota = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, num_bins), 1)
-        onehot = (b[:, None] == iota).astype(compute_dtype)  # VMEM only
-        acc = jax.lax.dot_general(
-            onehot, gh_ref[...].astype(compute_dtype),
-            dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=acc_dtype)  # [B, CH]
-        out_ref[0] += acc
+        for gi in range(GROUP_BLOCK):  # unrolled: static VMEM indices
+            b = bins_ref[gi, :]  # [TN] int32
+            onehot = (b[:, None] == iota).astype(compute_dtype)  # VMEM only
+            # [CH, B] orientation: B rides the 128-lane dim. The [B, CH]
+            # orientation pads CH (2-6) up to 128 output lanes — a 20x+ FLOP
+            # inflation that made histogram time scale with num_bins*128
+            # instead of num_bins*CH.
+            acc = jax.lax.dot_general(
+                gh, onehot,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=acc_dtype,
+                # without HIGHEST the MXU decomposes f32 operands into bf16
+                # passes, silently giving f32-mode the bf16 noise floor
+                precision=(jax.lax.Precision.HIGHEST
+                           if compute_dtype == jnp.float32 else
+                           jax.lax.Precision.DEFAULT))  # [CH, B]
+            out_ref[gi] += acc
 
     return kernel
 
 
+def hist_force_f32() -> bool:
+    """LGBM_TPU_HIST_F32=1 forces f32 operands. Resolved by the unjitted
+    dispatch wrappers in ops.histogram so it enters the jit cache key as the
+    `f32` static arg — but outer jitted callers (grow_tree_on_device) bake
+    the value into their own trace, so set it BEFORE the first training
+    call, not mid-run."""
+    return os.environ.get("LGBM_TPU_HIST_F32", "").lower() not in (
+        "", "0", "false", "off")
+
+
 @partial(jax.jit, static_argnames=("num_bins", "tile_rows", "quantized",
-                                   "interpret"))
+                                   "f32", "interpret"))
 def pallas_histogram(bins: jax.Array, gh: jax.Array, num_bins: int,
                      tile_rows: int = DEFAULT_TILE_ROWS,
                      quantized: bool = False,
+                     f32: bool = False,
                      interpret: bool = False) -> jax.Array:
     """[G, N] bins + [N, CH] gh -> [G, num_bins, CH] histogram.
 
     quantized: int8 one-hot x int8 gh with exact int32 accumulation
-    (MXU-native); otherwise f32 throughout. Rows are padded to the tile
-    size with zero gh (contributes nothing).
+    (MXU-native). Float path: bf16 operands with f32 accumulation — the MXU
+    runs bf16 at full rate while f32 matmuls cost multiple passes; the
+    one-hot is exactly representable and only the gh operand rounds (well
+    under the reference's own single-precision histogram noise floor,
+    feature_histogram.hpp hist_t=float). f32=True forces f32 operands.
+    Rows are padded to the tile size with zero gh (contributes nothing).
     """
     G, N = bins.shape
     CH = gh.shape[1]
-    compute_dtype = jnp.int8 if quantized else jnp.float32
-    acc_dtype = jnp.int32 if quantized else jnp.float32
+    if quantized:
+        compute_dtype, acc_dtype = jnp.int8, jnp.int32
+    elif f32:
+        compute_dtype, acc_dtype = jnp.float32, jnp.float32
+    else:
+        compute_dtype, acc_dtype = jnp.bfloat16, jnp.float32
     n_tiles = max(-(-N // tile_rows), 1)
     pad = n_tiles * tile_rows - N
     bins = bins.astype(jnp.int32)
     if pad:
         bins = jnp.pad(bins, ((0, 0), (0, pad)), constant_values=0)
         gh = jnp.pad(gh, ((0, pad), (0, 0)))  # zero gh => no contribution
+    g_blocks = max(-(-G // GROUP_BLOCK), 1)
+    g_pad = g_blocks * GROUP_BLOCK - G
+    if g_pad:  # padded groups accumulate into rows sliced off below
+        bins = jnp.pad(bins, ((0, g_pad), (0, 0)), constant_values=0)
     out = pl.pallas_call(
         _make_kernel(num_bins, tile_rows, compute_dtype, acc_dtype),
-        grid=(G, n_tiles),
+        grid=(g_blocks, n_tiles),
         in_specs=[
-            pl.BlockSpec((1, tile_rows), lambda g, t: (g, t)),
+            pl.BlockSpec((GROUP_BLOCK, tile_rows), lambda g, t: (g, t)),
             pl.BlockSpec((tile_rows, CH), lambda g, t: (t, 0)),
         ],
-        out_specs=pl.BlockSpec((1, num_bins, CH), lambda g, t: (g, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((G, num_bins, CH), acc_dtype),
+        out_specs=pl.BlockSpec((GROUP_BLOCK, CH, num_bins),
+                               lambda g, t: (g, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((g_blocks * GROUP_BLOCK, CH, num_bins),
+                                       acc_dtype),
         interpret=interpret,
     )(bins, gh)
-    return out
+    return out[:G].transpose(0, 2, 1)  # [G, B, CH]; 172KB, free vs the dot

@@ -90,11 +90,12 @@ def build_histogram(bins: jax.Array, gh: jax.Array, num_bins: int,
     if use_pallas is None:
         use_pallas = _use_pallas()
     if use_pallas:
-        from .hist_pallas import pallas_histogram
+        from .hist_pallas import hist_force_f32, pallas_histogram
 
         return pallas_histogram(
             bins.astype(jnp.int32), gh, num_bins,
-            quantized=jnp.issubdtype(jnp.dtype(compute_dtype), jnp.integer))
+            quantized=jnp.issubdtype(jnp.dtype(compute_dtype), jnp.integer),
+            f32=hist_force_f32())
     return _build_histogram_xla(bins, gh, num_bins, row_chunk, compute_dtype)
 
 
@@ -141,7 +142,7 @@ def build_histogram_rows(bins: jax.Array, gh_ext: jax.Array, row_idx: jax.Array,
     if use_pallas is None:
         use_pallas = _use_pallas()
     if use_pallas:
-        from .hist_pallas import pallas_histogram
+        from .hist_pallas import hist_force_f32, pallas_histogram
 
         G, N = bins.shape
         bins_leaf = jnp.take(bins, jnp.minimum(row_idx, N - 1),
@@ -149,7 +150,8 @@ def build_histogram_rows(bins: jax.Array, gh_ext: jax.Array, row_idx: jax.Array,
         gh_leaf = jnp.take(gh_ext, row_idx, axis=0)
         return pallas_histogram(
             bins_leaf, gh_leaf, num_bins,
-            quantized=jnp.issubdtype(jnp.dtype(compute_dtype), jnp.integer))
+            quantized=jnp.issubdtype(jnp.dtype(compute_dtype), jnp.integer),
+            f32=hist_force_f32())
     return _build_histogram_rows_xla(bins, gh_ext, row_idx, num_bins,
                                      row_chunk, compute_dtype)
 

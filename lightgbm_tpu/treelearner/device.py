@@ -6,19 +6,27 @@ TPU. This learner instead grows the ENTIRE tree inside a single jitted
 function: a `lax.fori_loop` over num_leaves-1 split steps carrying
 
     leaf_id    [N]          per-row leaf assignment (bagged-out rows = -1)
-    pool       [L+1,G,B,3]  per-leaf histogram cache (+1 dump row, see below)
     leaf_best  [L+1,R]      per-leaf packed best-split records
-    totals     [L+1,3]      per-leaf (sum_g, sum_h, count)
+    depth      [L+1]        per-leaf depth
     rec_store  [L,R+4]      the split log the host replays into a Tree
 
 Per step: argmax over leaf gains -> partition by leaf-id rewrite (the
-CUDADataPartition idea without compaction) -> left-child histogram as a
-masked full-N one-hot MXU contraction -> sibling by subtraction -> two split
-scans. All shapes are static; the only host traffic per TREE is the split
-log + final leaf ids. On the MXU a full-N histogram costs ~milliseconds of
-compute, so trading the reference's O(leaf_rows) index gathers
-(dense_bin.hpp ConstructHistogram) for O(N) static-shape masked work buys a
-254x reduction in round trips at negligible FLOP cost.
+CUDADataPartition idea without compaction) -> BOTH child histograms in one
+6-channel masked full-N one-hot MXU contraction -> two split scans. All
+shapes are static; the only host traffic per TREE is the split log + final
+leaf ids. On the MXU a full-N histogram costs ~milliseconds of compute, so
+trading the reference's O(leaf_rows) index gathers (dense_bin.hpp
+ConstructHistogram) for O(N) static-shape masked work buys a 254x reduction
+in round trips at negligible FLOP cost.
+
+Design note — no histogram pool, no subtraction trick: in this full-N
+masked formulation a child histogram costs the same whether the leaf holds
+10 rows or all of them, so `parent - sibling` (FeatureHistogram::Subtract)
+saves nothing; worse, a [L+1, G, B, 3] pool carried through the fori_loop
+defeats XLA's in-place buffer analysis once a Pallas call sits in the loop
+body (measured ~10 ms/split of copy traffic — 20x the histogram itself).
+Computing left+right directly as channels [gL,hL,cL,gR,hR,cR] of ONE
+contraction deletes the pool, the subtraction, and the copies.
 
 Conditional no-op steps (no positive gain left) write to the dump row L, so
 the loop body stays branch-free (tree.h leaf-wise semantics preserved:
@@ -46,8 +54,6 @@ from .serial import SerialTreeLearner, _leaf_output_host
 REC = len(SPLIT_FIELDS)
 # rec_store row: [leaf, parent_output, depth, valid] + SPLIT_FIELDS
 STORE = REC + 4
-# histogram pool budget before falling back to the host-driven learner
-POOL_BYTE_LIMIT = 2 << 30
 
 
 class FeatureTables(NamedTuple):
@@ -115,22 +121,29 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
     leaf_id0 [N] (0 for in-bag rows, -1 otherwise).
     quantized: gh is int8 (g_int, h_int, 1); histograms accumulate exact
     int32 on the MXU and re-enter float space via scale_vec at scan time —
-    the on-device twin of the serial learner's quantized path, with the
-    bonus that the histogram-subtraction trick becomes exact integer math.
+    the on-device twin of the serial learner's quantized path.
     Returns (rec_store [L-1, STORE], leaf_id [N], num_leaves_final).
     """
     L = num_leaves
     G = bins.shape[0]
     min_data, min_hess = params[2], params[3]
     neg_inf = jnp.float32(-jnp.inf)
+    gh_dtype = jnp.int8 if quantized else jnp.float32
+    zero_gh = jnp.zeros((), gh_dtype)
 
     def masked_hist(mask):
-        if quantized:
-            ghm = jnp.where(mask[:, None], gh, jnp.zeros((), gh.dtype))
-            return build_histogram(bins, ghm, num_bins,
-                                   compute_dtype=jnp.int8)
-        return build_histogram(bins, jnp.where(mask[:, None], gh, 0.0),
-                               num_bins)
+        ghm = jnp.where(mask[:, None], gh, zero_gh)
+        return build_histogram(bins, ghm, num_bins,
+                               compute_dtype=gh_dtype)
+
+    def children_hists(mask_l, mask_r):
+        """BOTH child histograms in one 6-channel contraction (no pool, no
+        subtraction — see module docstring)."""
+        gh6 = jnp.concatenate([jnp.where(mask_l[:, None], gh, zero_gh),
+                               jnp.where(mask_r[:, None], gh, zero_gh)],
+                              axis=1)  # [N, 6]
+        h6 = build_histogram(bins, gh6, num_bins, compute_dtype=gh_dtype)
+        return h6[..., :3], h6[..., 3:]
 
     def scan_hist(hist):
         if quantized:
@@ -153,9 +166,6 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
     root_hist = masked_hist(root_mask)
     root_tot = hist_totals(root_hist)
 
-    pool_dtype = jnp.int32 if quantized else jnp.float32
-    pool = jnp.zeros((L + 1, G, num_bins, 3), pool_dtype).at[0].set(root_hist)
-    totals = jnp.zeros((L + 1, 3), jnp.float32).at[0].set(root_tot)
     depth = jnp.zeros(L + 1, jnp.int32)
     leaf_best = jnp.full((L + 1, REC), neg_inf, jnp.float32)
     root_rec = guard(find_best_split(scan_hist(root_hist), root_tot, meta,
@@ -166,7 +176,7 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
     rec_store = rec_store.at[:, 3].set(0.0)  # valid flag
 
     def body(t, carry):
-        leaf_id, pool, totals, depth, leaf_best, rec_store, n_cur = carry
+        leaf_id, depth, leaf_best, rec_store, n_cur = carry
         gains = leaf_best[:L, 0]
         best_leaf = jnp.argmax(gains).astype(jnp.int32)
         rec = leaf_best[best_leaf]
@@ -184,10 +194,10 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         new_leaf = n_cur
         leaf_id = jnp.where(do & on_leaf & ~go_left, new_leaf, leaf_id)
 
-        left_hist = masked_hist(on_leaf & go_left)
-        right_hist = pool[best_leaf] - left_hist
+        left_hist, right_hist = children_hists(on_leaf & go_left,
+                                               on_leaf & ~go_left)
         ltot = hist_totals(left_hist)
-        rtot = totals[best_leaf] - ltot
+        rtot = hist_totals(right_hist)
         ndepth = depth[best_leaf] + 1
         lrec = guard(find_best_split(scan_hist(left_hist), ltot, meta, params,
                                      feature_mask),
@@ -198,7 +208,7 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
 
         # parent output for the tree's internal_value bookkeeping
         l1, l2, max_delta = params[0], params[1], params[5]
-        ptot = totals[best_leaf]
+        ptot = ltot + rtot
         pnum = -jnp.sign(ptot[0]) * jnp.maximum(jnp.abs(ptot[0]) - l1, 0.0)
         pout = pnum / jnp.maximum(ptot[1] + l2, 1e-15)
         pout = jnp.where(max_delta > 0,
@@ -207,8 +217,6 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         # no-op steps write to the dump row L
         wb = jnp.where(do, best_leaf, L)
         wn = jnp.where(do, new_leaf, L)
-        pool = pool.at[wb].set(left_hist).at[wn].set(right_hist)
-        totals = totals.at[wb].set(ltot).at[wn].set(rtot)
         depth = depth.at[wb].set(ndepth).at[wn].set(ndepth)
         leaf_best = leaf_best.at[wb].set(lrec).at[wn].set(rrec)
         leaf_best = leaf_best.at[L].set(jnp.full(REC, neg_inf))
@@ -219,12 +227,11 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
                        jnp.where(do, 1.0, 0.0)]), rec])
         rec_store = rec_store.at[t].set(row)
         n_cur = n_cur + jnp.where(do, 1, 0).astype(jnp.int32)
-        return leaf_id, pool, totals, depth, leaf_best, rec_store, n_cur
+        return leaf_id, depth, leaf_best, rec_store, n_cur
 
-    carry = (leaf_id0, pool, totals, depth, leaf_best, rec_store,
-             jnp.int32(1))
+    carry = (leaf_id0, depth, leaf_best, rec_store, jnp.int32(1))
     carry = jax.lax.fori_loop(0, L - 1, body, carry)
-    leaf_id, _, _, _, _, rec_store, n_cur = carry
+    leaf_id, _, _, rec_store, n_cur = carry
     return rec_store, leaf_id, n_cur
 
 
@@ -339,7 +346,3 @@ class DeviceTreeLearner(SerialTreeLearner):
                                     cfg.lambda_l1, cfg.lambda_l2,
                                     cfg.max_delta_step)
             tree.set_leaf_output(leaf, out)
-
-
-def pool_bytes(num_leaves: int, num_groups: int, num_bins: int) -> int:
-    return 4 * (num_leaves + 1) * num_groups * num_bins * 3

@@ -537,13 +537,14 @@ def create_tree_learner(learner_type: str, device_type: str, config: Config,
     """Factory (tree_learner.cpp:17-57). Distributed learners (feature/data/
     voting) are built on the parallel backend in parallel/."""
     if learner_type in ("serial",):
-        from .device import DeviceTreeLearner, pool_bytes, POOL_BYTE_LIMIT
+        from .device import DeviceTreeLearner
 
         # The on-device whole-tree learner trades O(leaf) index gathers for
         # O(N) static-shape masked histograms — near-free on the MXU, slow on
-        # the CPU backend — so it is selected on accelerators only (and when
-        # its histogram pool fits); device_type=cpu forces the host-driven
-        # learner regardless of the attached backend.
+        # the CPU backend — so it is selected on accelerators only;
+        # device_type=cpu forces the host-driven learner regardless of the
+        # attached backend (device_type defaults to "auto": see
+        # Config._post_process).
         try:
             on_accelerator = jax.default_backend() not in ("cpu",)
         except RuntimeError:
@@ -559,12 +560,8 @@ def create_tree_learner(learner_type: str, device_type: str, config: Config,
                       or CEGB.enabled(config)
                       or config.linear_tree
                       or bool(config.forcedsplits_filename))
-        if (device_type != "cpu" and on_accelerator and not has_cat
-                and not needs_host
-                and pool_bytes(
-                    config.num_leaves, dataset.num_groups,
-                    int(max(dataset.group_bin_counts().max(), 2))
-                ) <= POOL_BYTE_LIMIT):
+        if device_type != "cpu" and on_accelerator and not has_cat \
+                and not needs_host:
             return DeviceTreeLearner(config, dataset)
         return SerialTreeLearner(config, dataset)
     if learner_type in ("feature", "data", "voting"):

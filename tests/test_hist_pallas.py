@@ -24,12 +24,25 @@ def test_pallas_histogram_float(rng, n, tile):
     bins = rng.randint(0, B, size=(G, n)).astype(np.int32)
     gh = rng.randn(n, 3).astype(np.float32)
     ours = np.asarray(pallas_histogram(
-        jnp.asarray(bins), jnp.asarray(gh), B, tile_rows=tile,
+        jnp.asarray(bins), jnp.asarray(gh), B, tile_rows=tile, f32=True,
         interpret=True))
     np.testing.assert_allclose(ours, _ref_hist(bins, gh, B), rtol=1e-5,
                                atol=1e-4)
     xla = np.asarray(build_histogram(jnp.asarray(bins), jnp.asarray(gh), B))
     np.testing.assert_allclose(ours, xla, rtol=1e-5, atol=1e-4)
+
+
+def test_pallas_histogram_bf16_default(rng):
+    """The TPU default path: bf16 operands, f32 accumulation — sums must
+    track the exact histogram to bf16 operand-rounding tolerance."""
+    G, B, n = 4, 32, 20_000
+    bins = rng.randint(0, B, size=(G, n)).astype(np.int32)
+    gh = rng.randn(n, 3).astype(np.float32)
+    ours = np.asarray(pallas_histogram(
+        jnp.asarray(bins), jnp.asarray(gh), B, interpret=True))
+    assert ours.dtype == np.float32
+    ref = _ref_hist(bins, gh, B)
+    np.testing.assert_allclose(ours, ref, rtol=2e-2, atol=2e-1)
 
 
 def test_pallas_histogram_quantized_exact(rng):
