@@ -43,11 +43,13 @@ def _pandas_to_matrix(df, pandas_categorical=None):
     cat_cols = [i for i, dt in enumerate(df.dtypes)
                 if str(dt) == "category"]
     def _numeric(dt) -> bool:
-        try:
-            return bool(np.issubdtype(dt, np.number)
-                        or np.issubdtype(dt, np.bool_))
-        except TypeError:  # pandas extension dtype (nullable/datetime/...)
-            return False
+        import pandas as pd
+
+        # pd.api covers numpy dtypes AND nullable extension dtypes
+        # (Int64/Float64/boolean), which np.asarray converts cleanly;
+        # object/string/datetime columns are the ones to reject loudly
+        return bool(pd.api.types.is_numeric_dtype(dt)
+                    or pd.api.types.is_bool_dtype(dt))
 
     bad = [str(df.columns[i]) for i, dt in enumerate(df.dtypes)
            if i not in cat_cols and not _numeric(dt)]

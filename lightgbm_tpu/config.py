@@ -236,6 +236,8 @@ class Config:
         self.metric: List[str] = []
         if params:
             self.set(params)
+        else:  # defaults still need post-processing (device_type=auto etc.)
+            self._post_process()
 
     def set(self, params: Mapping[str, Any]) -> None:
         params = key_alias_transform(dict(params))

@@ -45,6 +45,24 @@ def test_pallas_histogram_bf16_default(rng):
     np.testing.assert_allclose(ours, ref, rtol=2e-2, atol=2e-1)
 
 
+def test_pallas_histogram_slots(rng):
+    """Slot-expanded wave histogram == per-slot masked histograms."""
+    from lightgbm_tpu.ops.hist_pallas import pallas_histogram_slots
+
+    G, B, n, S = 3, 16, 3000, 4
+    bins = rng.randint(0, B, size=(G, n)).astype(np.int32)
+    gh = rng.randn(n, 3).astype(np.float32)
+    slot = rng.randint(0, S + 2, size=n).astype(np.int32)  # S+ = dump
+    ours = np.asarray(pallas_histogram_slots(
+        jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(slot), B, S,
+        f32=True, interpret=True))
+    assert ours.shape == (G, B, S * 3)
+    for s in range(S):
+        ref = _ref_hist(bins, np.where((slot == s)[:, None], gh, 0.0), B)
+        np.testing.assert_allclose(ours[..., s * 3:(s + 1) * 3], ref,
+                                   rtol=1e-5, atol=1e-4)
+
+
 def test_pallas_histogram_quantized_exact(rng):
     G, B, n = 4, 32, 5000
     bins = rng.randint(0, B, size=(G, n)).astype(np.int32)
