@@ -63,8 +63,13 @@ def _pandas_to_matrix(df, pandas_categorical=None):
             len(cat_cols) != len(pandas_categorical):
         raise ValueError(
             "train and valid dataset categorical_feature do not match")
+    def _to_float(frame) -> np.ndarray:
+        # to_numpy(na_value=...) maps pd.NA in nullable extension columns
+        # to NaN; np.asarray would crash on NAType
+        return frame.to_numpy(dtype=np.float64, na_value=np.nan)
+
     if not cat_cols:
-        return np.asarray(df, dtype=np.float64), [], None
+        return _to_float(df), [], None
     df = df.copy(deep=False)
     cats_out = []
     for k, i in enumerate(cat_cols):
@@ -78,7 +83,7 @@ def _pandas_to_matrix(df, pandas_categorical=None):
         codes = col.cat.codes.to_numpy(dtype=np.float64, copy=True)
         codes[codes < 0] = np.nan  # missing / unseen categories
         df.isetitem(i, codes)
-    return np.asarray(df, dtype=np.float64), cat_cols, cats_out
+    return _to_float(df), cat_cols, cats_out
 
 
 def _to_2d_float(data) -> np.ndarray:
@@ -374,6 +379,11 @@ class Booster:
             self._gbdt.models = model.trees
             self._gbdt.num_class = model.num_class
             self._gbdt.num_tree_per_iteration = model.num_tree_per_iteration
+            # restore the iteration counter (GBDT::LoadModelFromString sets
+            # iter_ from the loaded tree count) so current_iteration() and
+            # the C API's out_num_iterations are right after a file load
+            self._gbdt.iter_ = (len(model.trees)
+                                // max(model.num_tree_per_iteration, 1))
             self._gbdt.objective = _objective_from_string(model.objective_str, self.config)
             self._gbdt.average_output = model.average_output
             self.train_set = None

@@ -1,0 +1,32 @@
+"""C API: a real shared library exporting the reference's LGBM_* surface.
+
+The reference ships its C API as src/c_api.cpp compiled into lib_lightgbm
+(include/LightGBM/c_api.h); every language binding (R, Java, C#, the CLI
+wrappers) sits on those symbols. Here the engine itself is Python/JAX, so
+the C API is built the other way around: `cffi` embedding compiles a
+native .so whose exported LGBM_* symbols trampoline into this package
+(build_capi.py). C clients #include lightgbm_tpu_c.h, link the .so, and
+get the familiar handle-based workflow:
+
+    LGBM_DatasetCreateFromMat -> LGBM_BoosterCreate ->
+    LGBM_BoosterUpdateOneIter -> LGBM_BoosterPredictForMat ->
+    LGBM_BoosterSaveModel / LGBM_GetLastError
+
+Handles are opaque integers into a process-global registry; every entry
+point stores its last exception for LGBM_GetLastError (c_api.cpp's
+LGBM_SetLastError convention). Build with:
+
+    python -m lightgbm_tpu.capi.build_capi --out build/
+
+Constants mirror c_api.h:35-43 (dtype / predict-type enums).
+"""
+
+C_API_DTYPE_FLOAT32 = 0
+C_API_DTYPE_FLOAT64 = 1
+C_API_DTYPE_INT32 = 2
+C_API_DTYPE_INT64 = 3
+
+C_API_PREDICT_NORMAL = 0
+C_API_PREDICT_RAW_SCORE = 1
+C_API_PREDICT_LEAF_INDEX = 2
+C_API_PREDICT_CONTRIB = 3

@@ -1,0 +1,187 @@
+"""Build the native C API shared library with cffi embedding.
+
+    python -m lightgbm_tpu.capi.build_capi --out build/
+
+produces liblightgbm_tpu.(so|dylib) exporting the LGBM_* symbols declared
+in lightgbm_tpu_c.h, plus the header itself. The .so embeds a Python
+interpreter (cffi embedding API): when a C program dlopens/links it, the
+first LGBM_* call initializes Python, imports lightgbm_tpu, and dispatches
+into capi.impl. Loaded inside an existing Python process it reuses that
+interpreter. Counterpart of src/c_api.cpp + lib_lightgbm in the reference
+build (CMakeLists.txt); signatures mirror include/LightGBM/c_api.h.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# Declarations shared by the embedding API and the public header.
+DECLS = """
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+const char* LGBM_GetLastError(void);
+
+int LGBM_DatasetCreateFromMat(const void* data, int data_type,
+                              int32_t nrow, int32_t ncol, int is_row_major,
+                              const char* parameters,
+                              DatasetHandle reference, DatasetHandle* out);
+int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
+                               DatasetHandle reference, DatasetHandle* out);
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int32_t num_element,
+                         int data_type);
+int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out);
+int LGBM_DatasetFree(DatasetHandle handle);
+
+int LGBM_BoosterCreate(DatasetHandle train_data, const char* parameters,
+                       BoosterHandle* out);
+int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data);
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int feature_importance_type,
+                          const char* filename);
+int LGBM_BoosterSaveModelToString(BoosterHandle handle, int start_iteration,
+                                  int num_iteration,
+                                  int feature_importance_type,
+                                  int64_t buffer_len, int64_t* out_len,
+                                  char* out_str);
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration);
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
+int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle, int* out_models);
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result);
+int LGBM_BoosterFree(BoosterHandle handle);
+"""
+
+HEADER_TEMPLATE = """/* lightgbm_tpu C API — LGBM_* surface per the reference's c_api.h.
+ * Link against liblightgbm_tpu; the library embeds the Python engine. */
+#ifndef LIGHTGBM_TPU_C_H_
+#define LIGHTGBM_TPU_C_H_
+#include <stdint.h>
+
+#define C_API_DTYPE_FLOAT32 (0)
+#define C_API_DTYPE_FLOAT64 (1)
+#define C_API_DTYPE_INT32   (2)
+#define C_API_DTYPE_INT64   (3)
+
+#define C_API_PREDICT_NORMAL     (0)
+#define C_API_PREDICT_RAW_SCORE  (1)
+#define C_API_PREDICT_LEAF_INDEX (2)
+#define C_API_PREDICT_CONTRIB    (3)
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+%s
+#ifdef __cplusplus
+}
+#endif
+#endif  /* LIGHTGBM_TPU_C_H_ */
+"""
+
+# Runs inside the embedded interpreter on first symbol use.
+INIT_CODE = """
+from lightgbm_tpu_capi_embed import ffi
+
+
+@ffi.def_extern()
+def LGBM_GetLastError():
+    from lightgbm_tpu.capi import impl
+    err = impl.last_error().encode()
+    # keep the buffer alive per-thread (c_api.cpp uses a thread_local
+    # std::string for the same reason): another thread's error must not
+    # free the pointer this thread is still reading
+    impl._err_local.buf = ffi.new("char[]", err)
+    return impl._err_local.buf
+
+
+def _bind(name, pyname):
+    def call(*args):
+        from lightgbm_tpu.capi import impl
+        try:
+            return getattr(impl, pyname)(ffi, *args)
+        except Exception as e:  # noqa: BLE001 - C boundary
+            from lightgbm_tpu.capi import impl
+            return impl.set_last_error(f"{type(e).__name__}: {e}")
+
+    ffi.def_extern(name=name)(call)
+
+
+_bind("LGBM_DatasetCreateFromMat", "dataset_create_from_mat")
+_bind("LGBM_DatasetCreateFromFile", "dataset_create_from_file")
+_bind("LGBM_DatasetSetField", "dataset_set_field")
+_bind("LGBM_DatasetGetNumData", "dataset_get_num_data")
+_bind("LGBM_DatasetGetNumFeature", "dataset_get_num_feature")
+_bind("LGBM_DatasetFree", "dataset_free")
+_bind("LGBM_BoosterCreate", "booster_create")
+_bind("LGBM_BoosterAddValidData", "booster_add_valid_data")
+_bind("LGBM_BoosterCreateFromModelfile", "booster_create_from_modelfile")
+_bind("LGBM_BoosterLoadModelFromString", "booster_load_model_from_string")
+_bind("LGBM_BoosterSaveModel", "booster_save_model")
+_bind("LGBM_BoosterSaveModelToString", "booster_save_model_to_string")
+_bind("LGBM_BoosterUpdateOneIter", "booster_update_one_iter")
+_bind("LGBM_BoosterGetCurrentIteration", "booster_get_current_iteration")
+_bind("LGBM_BoosterGetNumClasses", "booster_get_num_classes")
+_bind("LGBM_BoosterNumberOfTotalModel", "booster_number_of_total_model")
+_bind("LGBM_BoosterPredictForMat", "booster_predict_for_mat")
+_bind("LGBM_BoosterFree", "booster_free")
+"""
+
+
+def _handles_as_intptr(decls: str) -> str:
+    """cffi embedding wants concrete types; handles travel as intptr_t."""
+    return (decls.replace("typedef void* DatasetHandle;", "")
+                 .replace("typedef void* BoosterHandle;", "")
+                 .replace("DatasetHandle*", "intptr_t*")
+                 .replace("BoosterHandle*", "intptr_t*")
+                 .replace("DatasetHandle", "intptr_t")
+                 .replace("BoosterHandle", "intptr_t"))
+
+
+def build(out_dir: str) -> str:
+    import cffi
+
+    os.makedirs(out_dir, exist_ok=True)
+    ffibuilder = cffi.FFI()
+    ffibuilder.embedding_api(_handles_as_intptr(DECLS))
+    ffibuilder.set_source("lightgbm_tpu_capi_embed", """
+        #include <stdint.h>
+    """)
+    # make the package importable inside the embedded interpreter even when
+    # the host process is a plain C program started anywhere
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    init = (f"import sys; sys.path.insert(0, {repo_root!r})\n" + INIT_CODE)
+    ffibuilder.embedding_init_code(init)
+    target = os.path.join(out_dir, "liblightgbm_tpu.*")
+    so_path = ffibuilder.compile(target=target, tmpdir=out_dir, verbose=False)
+    header = os.path.join(out_dir, "lightgbm_tpu_c.h")
+    with open(header, "w") as f:
+        f.write(HEADER_TEMPLATE % DECLS)
+    return so_path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="build")
+    args = ap.parse_args(argv)
+    so = build(args.out)
+    print(so)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

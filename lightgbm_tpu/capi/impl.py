@@ -1,0 +1,248 @@
+"""Python implementations behind the exported LGBM_* symbols.
+
+Each function mirrors the contract of its namesake in the reference's
+src/c_api.cpp (return 0 on success, -1 on error with the message readable
+via LGBM_GetLastError; out-params filled through cffi pointers). The cffi
+embedding module (build_capi.py) binds these to the real C symbols.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict
+
+import numpy as np
+
+_lock = threading.RLock()
+_handles: Dict[int, Any] = {}
+_next_handle = [1]
+_last_error = threading.local()  # per-thread, like c_api.cpp's thread_local
+_err_local = threading.local()  # keeps each thread's returned char* alive
+
+_DTYPES = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+
+
+def set_last_error(msg: str) -> int:
+    _last_error.msg = msg
+    return -1
+
+
+def last_error() -> str:
+    return getattr(_last_error, "msg", "ok")
+
+
+def _register(obj) -> int:
+    with _lock:
+        h = _next_handle[0]
+        _next_handle[0] += 1
+        _handles[h] = obj
+    return h
+
+
+def _get(handle: int):
+    obj = _handles.get(int(handle))
+    if obj is None:
+        raise KeyError(f"invalid handle {handle}")
+    return obj
+
+
+def _free(handle: int) -> None:
+    _handles.pop(int(handle), None)
+
+
+def _parse_params(parameters: str) -> dict:
+    """'key=value key2=val2' (c_api.cpp Config::Str2Map format)."""
+    out = {}
+    for tok in (parameters or "").replace("\n", " ").split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k] = v
+    return out
+
+
+def _mat_from_ptr(ffi, data, data_type, nrow, ncol, is_row_major):
+    dt = _DTYPES.get(int(data_type))
+    if dt is None:
+        raise ValueError(f"unknown C_API_DTYPE {data_type}")
+    n = int(nrow) * int(ncol)
+    buf = ffi.buffer(data, n * np.dtype(dt).itemsize)
+    arr = np.frombuffer(buf, dtype=dt).copy()
+    if is_row_major:
+        return arr.reshape(int(nrow), int(ncol))
+    return arr.reshape(int(ncol), int(nrow)).T
+
+
+# ---- Dataset ----------------------------------------------------------------
+
+def dataset_create_from_mat(ffi, data, data_type, nrow, ncol, is_row_major,
+                            parameters, reference, out):
+    import lightgbm_tpu as lgb
+
+    X = _mat_from_ptr(ffi, data, data_type, nrow, ncol, is_row_major)
+    params = _parse_params(ffi.string(parameters).decode())
+    ref = _get(reference) if reference else None
+    ds = lgb.Dataset(X, params=params, reference=ref, free_raw_data=False)
+    out[0] = _register(ds)
+    return 0
+
+
+def dataset_create_from_file(ffi, filename, parameters, reference, out):
+    import lightgbm_tpu as lgb
+
+    params = _parse_params(ffi.string(parameters).decode())
+    ref = _get(reference) if reference else None
+    ds = lgb.Dataset(ffi.string(filename).decode(), params=params,
+                     reference=ref, free_raw_data=False)
+    out[0] = _register(ds)
+    return 0
+
+
+def dataset_set_field(ffi, handle, field_name, field_data, num_element,
+                      data_type):
+    """Field-name routing per c_api.cpp LGBM_DatasetSetField /
+    Metadata::SetField (label/weight/group/init_score/position)."""
+    ds = _get(handle)
+    name = ffi.string(field_name).decode()
+    dt = _DTYPES[int(data_type)]
+    buf = ffi.buffer(field_data, int(num_element) * np.dtype(dt).itemsize)
+    values = np.frombuffer(buf, dtype=dt).copy()
+    setters = {"label": ds.set_label, "weight": ds.set_weight,
+               "group": ds.set_group, "query": ds.set_group,
+               "init_score": ds.set_init_score, "position": ds.set_position}
+    if name not in setters:
+        raise ValueError(f"unknown field name {name!r}")
+    setters[name](values)
+    return 0
+
+
+def dataset_get_num_data(ffi, handle, out):
+    ds = _get(handle)
+    out[0] = int(ds.num_data())
+    return 0
+
+
+def dataset_get_num_feature(ffi, handle, out):
+    ds = _get(handle)
+    out[0] = int(ds.num_feature())
+    return 0
+
+
+def dataset_free(ffi, handle):
+    _free(handle)
+    return 0
+
+
+# ---- Booster ----------------------------------------------------------------
+
+def booster_create(ffi, train_data, parameters, out):
+    import lightgbm_tpu as lgb
+
+    params = _parse_params(ffi.string(parameters).decode())
+    bst = lgb.Booster(params=params, train_set=_get(train_data))
+    out[0] = _register(bst)
+    return 0
+
+
+def booster_add_valid_data(ffi, handle, valid_data):
+    bst = _get(handle)
+    n = getattr(bst, "_capi_valid_count", 0) + 1
+    bst._capi_valid_count = n
+    bst.add_valid(_get(valid_data), f"valid_{n}")
+    return 0
+
+
+def booster_create_from_modelfile(ffi, filename, out_num_iterations, out):
+    import lightgbm_tpu as lgb
+
+    bst = lgb.Booster(model_file=ffi.string(filename).decode())
+    out_num_iterations[0] = int(bst.current_iteration())
+    out[0] = _register(bst)
+    return 0
+
+
+def booster_load_model_from_string(ffi, model_str, out_num_iterations, out):
+    import lightgbm_tpu as lgb
+
+    bst = lgb.Booster(model_str=ffi.string(model_str).decode())
+    out_num_iterations[0] = int(bst.current_iteration())
+    out[0] = _register(bst)
+    return 0
+
+
+def booster_save_model(ffi, handle, start_iteration, num_iteration,
+                       importance_type, filename):
+    bst = _get(handle)
+    bst.save_model(ffi.string(filename).decode(),
+                   num_iteration=int(num_iteration),
+                   start_iteration=int(start_iteration),
+                   importance_type=("split" if int(importance_type) == 0
+                                    else "gain"))
+    return 0
+
+
+def booster_save_model_to_string(ffi, handle, start_iteration, num_iteration,
+                                 importance_type, buffer_len, out_len,
+                                 out_str):
+    bst = _get(handle)
+    s = bst.model_to_string(num_iteration=int(num_iteration),
+                            start_iteration=int(start_iteration),
+                            importance_type=("split" if int(importance_type)
+                                             == 0 else "gain")).encode()
+    out_len[0] = len(s) + 1
+    if int(buffer_len) >= len(s) + 1:
+        buf = ffi.buffer(out_str, len(s) + 1)
+        buf[:len(s)] = s
+        buf[len(s):len(s) + 1] = b"\0"
+    return 0
+
+
+def booster_update_one_iter(ffi, handle, is_finished):
+    bst = _get(handle)
+    finished = bst.update()
+    is_finished[0] = 1 if finished else 0
+    return 0
+
+
+def booster_get_current_iteration(ffi, handle, out_iteration):
+    out_iteration[0] = int(_get(handle).current_iteration())
+    return 0
+
+
+def booster_get_num_classes(ffi, handle, out_len):
+    out_len[0] = int(getattr(_get(handle), "num_model_per_iteration",
+                             lambda: 1)())
+    return 0
+
+
+def booster_number_of_total_model(ffi, handle, out_models):
+    bst = _get(handle)
+    out_models[0] = int(bst.num_trees())
+    return 0
+
+
+def booster_predict_for_mat(ffi, handle, data, data_type, nrow, ncol,
+                            is_row_major, predict_type, start_iteration,
+                            num_iteration, parameter, out_len, out_result):
+    bst = _get(handle)
+    X = _mat_from_ptr(ffi, data, data_type, nrow, ncol, is_row_major)
+    pt = int(predict_type)
+    # prediction options travel in the parameter string
+    # (LGBM_BoosterPredictForMat parses it via Config::Str2Map)
+    extra = _parse_params(ffi.string(parameter).decode())
+    pred = bst.predict(
+        X,
+        raw_score=(pt == 1),
+        pred_leaf=(pt == 2),
+        pred_contrib=(pt == 3),
+        start_iteration=int(start_iteration),
+        num_iteration=int(num_iteration),
+        **extra,
+    )
+    flat = np.ascontiguousarray(pred, dtype=np.float64).ravel()
+    out_len[0] = flat.size
+    ffi.buffer(out_result, flat.size * 8)[:] = flat.tobytes()
+    return 0
+
+
+def booster_free(ffi, handle):
+    _free(handle)
+    return 0

@@ -118,6 +118,17 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
 def _train_loop(booster, params, feval, fobj, init_iteration, num_boost_round,
                 callbacks_before, callbacks_after) -> bool:
+    from .utils.profile import maybe_trace
+
+    with maybe_trace():  # device trace when LGBM_TPU_PROFILE=<dir> is set
+        return _train_loop_inner(booster, params, feval, fobj,
+                                 init_iteration, num_boost_round,
+                                 callbacks_before, callbacks_after)
+
+
+def _train_loop_inner(booster, params, feval, fobj, init_iteration,
+                      num_boost_round, callbacks_before,
+                      callbacks_after) -> bool:
     is_finished = False
     evaluation_result_list = None
     for i in range(init_iteration, init_iteration + num_boost_round):

@@ -35,7 +35,8 @@ def _build() -> Optional[str]:
     # build to a per-process temp file + atomic rename: concurrent workers
     # (lightgbm_tpu.launch) must never dlopen a half-written .so
     tmp = f"{so}.build.{os.getpid()}"
-    cmd = ["g++", "-O2", "-shared", "-fPIC", f"-I{include}", _SRC, "-o", tmp]
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", f"-I{include}",
+           _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, so)

@@ -1,11 +1,16 @@
 // Native text parser for lightgbm_tpu.
 //
 // TPU-native counterpart of the reference's C++ Parser stack
-// (src/io/parser.cpp CSVParser/TSVParser/LibSVMParser): tokenizes CSV/TSV
-// (single-char or whitespace delimited) and LibSVM files with strtod in one
-// pass over a buffered read. Exposed as a tiny CPython extension module
-// (no pybind11 — plain Python C API) returning raw double buffers the
-// Python side wraps with np.frombuffer; built on demand by build.py.
+// (src/io/parser.cpp CSVParser/TSVParser/LibSVMParser + the OMP block
+// parsing in src/io/dataset_loader.cpp LoadTextDataToMemory): tokenizes
+// CSV/TSV (single-char or whitespace delimited) and LibSVM files with
+// strtod. Dense parsing is PIPELINED: the buffer splits at line boundaries
+// into one shard per hardware thread, shards parse concurrently with the
+// GIL released, results concatenate in order — the std::thread analog of
+// the reference's `#pragma omp parallel for` over line blocks. Exposed as
+// a tiny CPython extension module (no pybind11 — plain Python C API)
+// returning raw double buffers the Python side wraps with np.frombuffer;
+// built on demand by __init__.py.
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
@@ -15,6 +20,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -44,7 +50,60 @@ inline double parse_token(const char* tok, const char* end) {
   return v;
 }
 
-// dense CSV/TSV: delim == 0 means "any whitespace run"
+struct ShardResult {
+  std::vector<double> values;
+  long rows = 0;
+  long ncols = -1;
+  bool bad = false;  // inconsistent column count inside this shard
+};
+
+// parse one [p, fend) line-aligned shard; delim == 0 means "any whitespace"
+void parse_dense_range(const char* p, const char* fend, char delim,
+                       ShardResult* out) {
+  while (p < fend) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(fend - p)));
+    if (!line_end) line_end = fend;
+    const char* q = p;
+    const char* qe = line_end;
+    if (qe > q && qe[-1] == '\r') --qe;
+    if (q == qe) {  // blank line
+      p = line_end + 1;
+      continue;
+    }
+    long row_cols = 0;
+    if (delim == 0) {
+      while (q < qe) {
+        while (q < qe && std::isspace(static_cast<unsigned char>(*q))) ++q;
+        if (q >= qe) break;
+        const char* tok = q;
+        while (q < qe && !std::isspace(static_cast<unsigned char>(*q))) ++q;
+        out->values.push_back(parse_token(tok, q));
+        ++row_cols;
+      }
+    } else {
+      const char* tok = q;
+      for (;; ++q) {
+        if (q == qe || *q == delim) {
+          out->values.push_back(parse_token(tok, q));
+          ++row_cols;
+          if (q == qe) break;
+          tok = q + 1;
+        }
+      }
+    }
+    if (out->ncols < 0) {
+      out->ncols = row_cols;
+    } else if (row_cols != out->ncols) {
+      out->bad = true;
+      return;
+    }
+    ++out->rows;
+    p = line_end + 1;
+  }
+}
+
+// dense CSV/TSV: pipelined over hardware threads, GIL released
 PyObject* parse_dense(PyObject*, PyObject* args) {
   const char* path;
   int delim_int, skip_header;
@@ -57,63 +116,74 @@ PyObject* parse_dense(PyObject*, PyObject* args) {
     PyErr_SetString(PyExc_OSError, "cannot open data file");
     return nullptr;
   }
-  std::vector<double> values;
-  values.reserve(1 << 20);
-  Py_ssize_t nrows = 0, ncols = -1;
   const char* p = buf.data();
   const char* fend = p + buf.size();
-  int line_no = 0;
-  while (p < fend) {
+  if (skip_header && p < fend) {  // drop the first line
     const char* line_end = static_cast<const char*>(
         std::memchr(p, '\n', static_cast<size_t>(fend - p)));
-    if (!line_end) line_end = fend;
-    const char* q = p;
-    const char* qe = line_end;
-    if (qe > q && qe[-1] == '\r') --qe;
-    ++line_no;
-    if (skip_header && line_no == 1) {
-      p = line_end + 1;
-      continue;
-    }
-    if (q == qe) {  // blank line
-      p = line_end + 1;
-      continue;
-    }
-    Py_ssize_t row_cols = 0;
-    if (delim == 0) {
-      while (q < qe) {
-        while (q < qe && std::isspace(static_cast<unsigned char>(*q))) ++q;
-        if (q >= qe) break;
-        const char* tok = q;
-        while (q < qe && !std::isspace(static_cast<unsigned char>(*q))) ++q;
-        values.push_back(parse_token(tok, q));
-        ++row_cols;
+    p = line_end ? line_end + 1 : fend;
+  }
+
+  unsigned hw = std::thread::hardware_concurrency();
+  size_t n_shards = hw ? (hw > 16 ? 16 : hw) : 1;
+  if (static_cast<size_t>(fend - p) < (4u << 20)) n_shards = 1;
+  std::vector<ShardResult> shards(n_shards);
+  {
+    // shard boundaries snapped forward to the next newline
+    std::vector<const char*> starts(n_shards + 1);
+    size_t span = static_cast<size_t>(fend - p) / n_shards;
+    starts[0] = p;
+    for (size_t s = 1; s < n_shards; ++s) {
+      const char* cut = p + s * span;
+      if (cut >= fend) {
+        cut = fend;
+      } else {
+        const char* nl = static_cast<const char*>(
+            std::memchr(cut, '\n', static_cast<size_t>(fend - cut)));
+        cut = nl ? nl + 1 : fend;
       }
-    } else {
-      const char* tok = q;
-      for (;; ++q) {
-        if (q == qe || *q == delim) {
-          values.push_back(parse_token(tok, q));
-          ++row_cols;
-          if (q == qe) break;
-          tok = q + 1;
-        }
-      }
+      starts[s] = cut < starts[s - 1] ? starts[s - 1] : cut;
     }
-    if (ncols < 0) {
-      ncols = row_cols;
-    } else if (row_cols != ncols) {
+    starts[n_shards] = fend;
+
+    Py_BEGIN_ALLOW_THREADS;
+    std::vector<std::thread> workers;
+    for (size_t s = 1; s < n_shards; ++s) {
+      workers.emplace_back(parse_dense_range, starts[s], starts[s + 1],
+                           delim, &shards[s]);
+    }
+    parse_dense_range(starts[0], starts[1], delim, &shards[0]);
+    for (auto& w : workers) w.join();
+    Py_END_ALLOW_THREADS;
+  }
+
+  Py_ssize_t nrows = 0, ncols = -1;
+  size_t total_values = 0;
+  for (const auto& sh : shards) {
+    if (sh.bad) {
       PyErr_SetString(PyExc_ValueError, "inconsistent column count");
       return nullptr;
     }
-    ++nrows;
-    p = line_end + 1;
+    if (sh.ncols >= 0) {
+      if (ncols < 0) {
+        ncols = sh.ncols;
+      } else if (sh.ncols != ncols) {
+        PyErr_SetString(PyExc_ValueError, "inconsistent column count");
+        return nullptr;
+      }
+    }
+    nrows += sh.rows;
+    total_values += sh.values.size();
   }
   if (ncols < 0) ncols = 0;
   PyObject* bytes = PyBytes_FromStringAndSize(
-      reinterpret_cast<const char*>(values.data()),
-      static_cast<Py_ssize_t>(values.size() * sizeof(double)));
+      nullptr, static_cast<Py_ssize_t>(total_values * sizeof(double)));
   if (!bytes) return nullptr;
+  char* dst = PyBytes_AS_STRING(bytes);
+  for (const auto& sh : shards) {
+    std::memcpy(dst, sh.values.data(), sh.values.size() * sizeof(double));
+    dst += sh.values.size() * sizeof(double);
+  }
   return Py_BuildValue("(Nnn)", bytes, nrows, ncols);
 }
 

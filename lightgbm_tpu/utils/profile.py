@@ -1,0 +1,49 @@
+"""Device-level profiling: jax.profiler trace capture around training.
+
+The reference's tracing story is the CHECK/timer macros summarized at exit
+(src/utils/common.h timers, Log::Info dumps); ours is two layers:
+
+  * `global_timer` (utils/timer.py) — host-side scoped wall-clock sums,
+    printed via `print_timer_summary()` like the reference's timer table.
+  * THIS module — XLA device traces. `maybe_trace()` wraps a training run
+    in `jax.profiler.trace` when LGBM_TPU_PROFILE=<dir> is set (or a dir is
+    passed explicitly), producing a TensorBoard-loadable xplane profile of
+    every kernel the run dispatched. Used by engine.train and the CLI, so
+
+        LGBM_TPU_PROFILE=/tmp/prof python -m lightgbm_tpu.cli config=...
+
+    captures the whole training run with zero code changes.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+from .log import Log
+
+ENV_VAR = "LGBM_TPU_PROFILE"
+
+
+@contextlib.contextmanager
+def maybe_trace(trace_dir: Optional[str] = None):
+    """Trace into `trace_dir` (or $LGBM_TPU_PROFILE); no-op when unset."""
+    target = trace_dir or os.environ.get(ENV_VAR)
+    if not target:
+        yield
+        return
+    import jax
+
+    Log.info("Profiling to %s (load with TensorBoard's profile plugin)",
+             target)
+    with jax.profiler.trace(target):
+        yield
+    Log.info("Profile written to %s", target)
+
+
+def annotate(name: str):
+    """Named sub-span inside a capture (jax.profiler.TraceAnnotation), for
+    marking phases (binning, tree N, eval) in the device timeline."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

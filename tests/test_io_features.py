@@ -103,3 +103,23 @@ def test_cli_save_binary_cache(rng, tmp_path):
     ds = lgb.Dataset(cache)
     ds.construct()
     assert ds._handle.num_data == 400
+
+
+def test_profiler_trace_capture(rng, tmp_path, monkeypatch):
+    """LGBM_TPU_PROFILE=<dir> wraps training in a jax.profiler trace and
+    leaves a TensorBoard-loadable profile behind (utils/profile.py)."""
+    import os
+
+    import lightgbm_tpu as lgb
+
+    trace_dir = str(tmp_path / "prof")
+    monkeypatch.setenv("LGBM_TPU_PROFILE", trace_dir)
+    X = rng.randn(300, 5)
+    y = (X[:, 0] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y)
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1},
+              ds, num_boost_round=2)
+    found = []
+    for root, _dirs, files in os.walk(trace_dir):
+        found.extend(f for f in files if "xplane" in f or f.endswith(".json.gz"))
+    assert found, f"no profile artifacts under {trace_dir}"

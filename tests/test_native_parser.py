@@ -62,3 +62,21 @@ def test_libsvm_parity():
 def test_reference_example_parses_identically():
     path = "/root/reference/examples/binary_classification/binary.train"
     _parity(path)
+
+
+def test_native_parse_dense_multithreaded(tmp_path):
+    """Files past the shard threshold take the pipelined multi-shard path;
+    results must be byte-identical to the single-shard/numpy parse."""
+    native = pytest.importorskip("lightgbm_tpu.native").get_parser()
+    if native is None:
+        pytest.skip("native parser unavailable")
+    rng = np.random.RandomState(3)
+    rows, cols = 70_000, 10  # ~5.5 MB > the 4 MB sharding threshold
+    M = rng.randn(rows, cols).round(6)
+    path = tmp_path / "big.csv"
+    np.savetxt(path, M, delimiter=",", fmt="%.6f")
+    assert path.stat().st_size > (4 << 20)
+    buf, nr, nc = native.parse_dense(str(path), ord(","), 0)
+    assert (nr, nc) == (rows, cols)
+    out = np.frombuffer(buf, dtype=np.float64).reshape(rows, cols)
+    np.testing.assert_allclose(out, M, atol=1e-9)
