@@ -183,6 +183,13 @@ class Dataset:
                 feature_names = [str(c) for c in data.columns]
             if pd_cat_cols and self.categorical_feature == "auto":
                 self.categorical_feature = pd_cat_cols
+        elif hasattr(data, "tocsc") and hasattr(data, "nnz"):
+            # scipy sparse: stays sparse into the core Dataset, which bins
+            # column-by-column (io/dataset.py) — never densified whole
+            X = data
+            if params.get("linear_tree"):
+                Log.fatal("linear_tree requires dense input "
+                          "(raw feature values per leaf)")
         else:
             X = _to_2d_float(data)
 
@@ -214,7 +221,10 @@ class Dataset:
             ref_handle = self.reference._handle
 
         if self.used_indices is not None:
-            X = X[self.used_indices]
+            if hasattr(X, "tocsr"):
+                X = X.tocsr()[self.used_indices]
+            else:
+                X = X[self.used_indices]
             label = (np.asarray(label)[self.used_indices]
                      if label is not None else None)
 
@@ -225,7 +235,13 @@ class Dataset:
             feature_names=feature_names, reference=ref_handle)
         if config.monotone_constraints:
             self._handle.monotone_constraints = list(config.monotone_constraints)
-        self._raw = np.asarray(X, dtype=np.float32)
+        # raw values back linear trees / refit; a sparse X stays un-densified
+        # (linear_tree was rejected above; refit/valid-eval densify on demand)
+        if hasattr(X, "tocsc"):
+            self._raw = None
+            self._sparse_raw = X
+        else:
+            self._raw = np.asarray(X, dtype=np.float32)
         if self.free_raw_data:
             self.data = None
         return self
@@ -244,6 +260,9 @@ class Dataset:
                       free_raw_data=self.free_raw_data)
         sub._handle = self._handle.subset(np.asarray(used_indices))
         sub._raw = self._raw[np.asarray(used_indices)] if self._raw is not None else None
+        if self._raw is None and getattr(self, "_sparse_raw", None) is not None:
+            # keep the sliced rows sparse too (cv folds of a sparse train set)
+            sub._sparse_raw = self._sparse_raw.tocsr()[np.asarray(used_indices)]
         sub.reference = self
         return sub
 
@@ -399,7 +418,15 @@ class Booster:
             raise TypeError(f"Validation data should be Dataset instance, "
                             f"met {type(data).__name__}")
         data.construct()
-        self._gbdt.add_valid(data._handle, data._raw, name)
+        raw = data._raw
+        if raw is None and getattr(data, "_sparse_raw", None) is not None:
+            # valid-set eval traverses raw feature values on device; a
+            # sparse VALID set densifies here (valid << train in practice —
+            # the train matrix itself is never densified). astype BEFORE
+            # toarray: the f32 conversion on the sparse side halves the
+            # transient peak vs densify-then-cast.
+            raw = data._sparse_raw.astype(np.float32).toarray()
+        self._gbdt.add_valid(data._handle, raw, name)
         self.name_valid_sets.append(name)
         return self
 

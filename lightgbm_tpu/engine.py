@@ -55,6 +55,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
         # continued training: raw scores of the loaded model seed init_score
         train_set.construct()
         raw = train_set._raw
+        if raw is None:  # sparse train set: predict densifies per matrix
+            raw = getattr(train_set, "_sparse_raw", None)
         init_score = predictor_model.predict(raw, raw_score=True)
         train_set.set_init_score(np.asarray(init_score, dtype=np.float64).ravel(order="F"))
 
@@ -74,7 +76,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
             valid_data.params = {**valid_data.params, **params}
             if predictor_model is not None:
                 valid_data.construct()
-                vi = predictor_model.predict(valid_data._raw, raw_score=True)
+                vraw = valid_data._raw
+                if vraw is None:
+                    vraw = getattr(valid_data, "_sparse_raw", None)
+                vi = predictor_model.predict(vraw, raw_score=True)
                 valid_data.set_init_score(np.asarray(vi, dtype=np.float64).ravel(order="F"))
             booster.add_valid(valid_data, name)
 
@@ -91,24 +96,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     booster.best_iteration = -1
     is_finished = False
-    # §5 tracing: LGBM_TPU_PROFILE_DIR wraps the boosting loop in a
-    # jax.profiler trace (viewable in TensorBoard/Perfetto), composing with
-    # the LGBM_TPU_TIMETAG per-scope TraceAnnotations from utils/timer.py
-    profile_dir = os.environ.get("LGBM_TPU_PROFILE_DIR")
-    if profile_dir:
-        import jax.profiler
-
-        jax.profiler.start_trace(profile_dir)
+    # §5 tracing: _train_loop wraps the boosting loop in a jax.profiler
+    # trace when LGBM_TPU_PROFILE(_DIR) is set (utils/profile.maybe_trace),
+    # composing with LGBM_TPU_TIMETAG per-scope TraceAnnotations
     try:
         is_finished = _train_loop(
             booster, params, feval, fobj, init_iteration, num_boost_round,
             callbacks_before, callbacks_after)
     finally:
-        if profile_dir:
-            import jax.profiler
-
-            jax.profiler.stop_trace()
-            Log.info("Profiler trace written to %s", profile_dir)
         if global_timer.enabled:
             Log.info("%s", global_timer.report())
     if booster.best_iteration <= 0:

@@ -81,6 +81,21 @@ class FeatureGroup:
         return off, off + m.num_bin - 1, m.default_bin
 
 
+def _is_sparse(data) -> bool:
+    """scipy.sparse duck-check (no hard scipy dependency)."""
+    return hasattr(data, "tocsc") and hasattr(data, "nnz")
+
+
+def _column(data, j: int) -> np.ndarray:
+    """Dense f64 view of column j for ndarray or CSC input — sparse stays
+    sparse end to end except for one transient column at a time. Slice
+    syntax (not getcol) so both spmatrix and the newer sparse-array classes
+    (csc_array has no getcol) work."""
+    if _is_sparse(data):
+        return data[:, [j]].toarray().ravel().astype(np.float64)
+    return data[:, j]
+
+
 def _sample_for_binning(col: np.ndarray, sample_cnt: int, rng: np.random.RandomState) -> Tuple[np.ndarray, int]:
     """Sample values (keeping NaNs, dropping zeros implicitly like the
     reference's sparse sample push) for bin finding."""
@@ -191,9 +206,18 @@ class Dataset:
                     reference: Optional["Dataset"] = None) -> "Dataset":
         config = config or Config()
         self = cls(config)
-        data = np.asarray(data)
-        if data.dtype not in (np.float32, np.float64):
-            data = data.astype(np.float64)
+        if _is_sparse(data):
+            # sparse path (DatasetLoader::ConstructFromSampleData with CSR
+            # input, c_api.cpp LGBM_DatasetCreateFromCSR): keep the matrix
+            # CSC and densify ONE COLUMN at a time — peak memory is the
+            # uint8 bin matrix + a single f64 column, never the dense raw
+            data = data.tocsc()
+            if data.dtype not in (np.float32, np.float64):
+                data = data.astype(np.float64)
+        else:
+            data = np.asarray(data)
+            if data.dtype not in (np.float32, np.float64):
+                data = data.astype(np.float64)
         n, f = data.shape
         self.num_data = n
         self.num_total_features = f
@@ -246,7 +270,7 @@ class Dataset:
             except OSError:
                 Log.warning("Could not open %s", config.forcedbins_filename)
         for j in range(f):
-            col = data[sample_idx, j]
+            col = _column(data, j)[sample_idx]
             nonzero = col[(col != 0) | np.isnan(col)]
             mapper = BinMapper()
             bt = BIN_TYPE_CATEGORICAL if j in cat_set else BIN_TYPE_NUMERICAL
@@ -293,11 +317,12 @@ class Dataset:
         for gi, fg in enumerate(self.groups):
             if not fg.is_multi:
                 j = fg.feature_indices[0]
-                self.bins[gi] = self.mappers[j].values_to_bins(data[:, j]).astype(dtype)
+                self.bins[gi] = self.mappers[j].values_to_bins(
+                    _column(data, j)).astype(dtype)
             else:
                 acc = np.zeros(self.num_data, dtype=np.int32)
                 for mi, j in enumerate(fg.feature_indices):
-                    raw = self.mappers[j].values_to_bins(data[:, j])
+                    raw = self.mappers[j].values_to_bins(_column(data, j))
                     gb = fg.bin_for_feature(mi, raw)
                     # exclusivity: at most one member non-default per row;
                     # on conflict the later feature wins (matches bundle
