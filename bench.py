@@ -89,10 +89,29 @@ def make_data(n_rows: int):
     return X, y
 
 
+def _auc(y, score) -> float:
+    """Rank-based AUC (no sklearn dependency)."""
+    import numpy as np
+
+    order = np.argsort(score)
+    ranks = np.empty(len(score))
+    ranks[order] = np.arange(1, len(score) + 1)
+    pos = y > 0.5
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2)
+                 / (n_pos * n_neg))
+
+
 def run_bench(n_rows: int) -> dict:
     import lightgbm_tpu as lgb
 
-    X, y = make_data(n_rows)
+    holdout = min(200_000, n_rows // 5)
+    Xall, yall = make_data(n_rows + holdout)
+    # true holdout: rows NEVER seen by training
+    Xh, yh = Xall[:holdout], yall[:holdout]
+    X, y = Xall[holdout:], yall[holdout:]
     params = {
         "objective": "binary",
         "num_leaves": 255,
@@ -110,8 +129,29 @@ def run_bench(n_rows: int) -> dict:
         bst.update()
     elapsed = time.perf_counter() - t0
     rips = n_rows * N_ITERS / elapsed
-    return {"row_iters_per_sec": rips, "elapsed_s": elapsed, "rows": n_rows,
-            "iters": N_ITERS}
+    out = {"row_iters_per_sec": rips, "elapsed_s": elapsed, "rows": n_rows,
+           "iters": N_ITERS,
+           "auc": round(_auc(yh, bst.predict(Xh)), 4)}
+
+    if os.environ.get("BENCH_QUANTIZED", "1") not in ("0", "false"):
+        # secondary metric: the int8 quantized-gradient path
+        # (use_quantized_grad, the reference's gradient_discretizer feature)
+        try:
+            dq = lgb.Dataset(X, label=y)
+            bq = lgb.Booster(params={**params, "use_quantized_grad": True},
+                             train_set=dq)
+            for _ in range(WARMUP_ITERS):
+                bq.update()
+            t0 = time.perf_counter()
+            for _ in range(N_ITERS):
+                bq.update()
+            eq = time.perf_counter() - t0
+            out["quantized_row_iters_per_sec"] = round(
+                n_rows * N_ITERS / eq, 1)
+            out["quantized_auc"] = round(_auc(yh, bq.predict(Xh)), 4)
+        except Exception as e:  # noqa: BLE001 - secondary must not kill primary
+            out["quantized_error"] = repr(e)[:200]
+    return out
 
 
 def main() -> None:
@@ -152,6 +192,10 @@ def main() -> None:
             record["elapsed_s"] = round(res["elapsed_s"], 3)
             record["rows"] = res["rows"]
             record["iters"] = res["iters"]
+            for k in ("auc", "quantized_row_iters_per_sec", "quantized_auc",
+                      "quantized_error"):
+                if k in res:
+                    record[k] = res[k]
             emit(record)
             return
         except Exception as e:  # noqa: BLE001 - degrade, don't crash

@@ -351,6 +351,10 @@ class Dataset:
                 num_total_features=h.num_total_features,
                 monotone=json.dumps(h.monotone_constraints),
                 raw=self._raw if self._raw is not None else [])
+        if self._raw is None and getattr(self, "_sparse_raw", None) is not None:
+            Log.warning("save_binary: raw feature values of a sparse-built "
+                        "Dataset are not cached; the reloaded Dataset can "
+                        "train but cannot serve as a validation set")
         return self
 
 
@@ -419,6 +423,10 @@ class Booster:
                             f"met {type(data).__name__}")
         data.construct()
         raw = data._raw
+        if raw is None and getattr(data, "_sparse_raw", None) is None:
+            Log.fatal("Validation-set evaluation needs raw feature values; "
+                      "this Dataset has none (e.g. reloaded from a binary "
+                      "cache of sparse input)")
         if raw is None and getattr(data, "_sparse_raw", None) is not None:
             # valid-set eval traverses raw feature values on device; a
             # sparse VALID set densifies here (valid << train in practice —
@@ -457,6 +465,8 @@ class Booster:
               **kwargs: Any) -> "Booster":
         """Refit the existing tree structures on new data
         (python-package Booster.refit / LGBM_BoosterRefit)."""
+        if hasattr(data, "toarray"):  # scipy sparse: refit needs raw values
+            data = data.toarray()
         data = np.asarray(data, dtype=np.float64)
         pred_leaf = self.predict(data, pred_leaf=True)
         new_params = {**self.params, "refit_decay_rate": decay_rate}

@@ -8,7 +8,6 @@ from __future__ import annotations
 
 import collections
 import copy
-import os
 from typing import Any, Callable, Dict, Iterable, List, Optional, Union
 
 import numpy as np

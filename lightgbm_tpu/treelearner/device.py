@@ -158,11 +158,10 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         bins = jnp.pad(bins, ((0, 0), (0, Np - N)), constant_values=0)
         gh = jnp.pad(gh, ((0, Np - N), (0, 0)))
         leaf_id0 = jnp.pad(leaf_id0, (0, Np - N), constant_values=-1)
-    # bf16 slot-matrix only where the Pallas kernel (which computes bf16
-    # regardless) consumes it; the XLA/CPU path keeps f32 operands exact
-    ghK_bf16 = (not quantized) and _use_pallas() and not hist_force_f32()
+    # in-kernel slot expansion is the default on TPU (the XLA-side [N, 2K*CH]
+    # materialization profiled at ~18 ms/wave); LGBM_TPU_HIST_SLOTS=0 opts out
     slots_kernel = _use_pallas() and os.environ.get(
-        "LGBM_TPU_HIST_SLOTS", "").lower() in ("1", "true", "on")
+        "LGBM_TPU_HIST_SLOTS", "1").lower() not in ("0", "false", "off")
 
     def masked_hist(mask):
         ghm = jnp.where(mask[:, None], gh, zero_gh)
@@ -214,48 +213,63 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         thresh_k = recs_sel[:, 2].astype(jnp.int32)
         defl_k = recs_sel[:, 3] > 0.5
 
-        # --- per-row wave slot: which selected leaf (if any) owns this row
+        # --- per-row wave slot: which selected leaf (if any) owns this row.
+        # The [N, K] compare stays VECTORIZED on the VPU; a [L+1]-table
+        # gather formulation measured ~20% slower end to end (TPU gathers
+        # serialize, elementwise compares do not).
         match = (leaf_id[:, None] == sel[None, :]) & sel_ok[None, :]  # [N, K]
         kvalid = match.any(axis=1)
         kidx = jnp.argmax(match, axis=1).astype(jnp.int32)  # [N], junk if !kvalid
 
-        def row_field(per_k):
-            return jnp.take(per_k, kidx)
+        # per-row split fields as masked matvecs over the [N, K] match —
+        # vectorized VPU/MXU work; jnp.take gathers here measured far
+        # slower (TPU gathers serialize). Field values are small ints,
+        # exact in f32.
+        matchf = match.astype(jnp.float32)
 
-        grp_row = row_field(tables.group[f_k])
-        gb_row = jnp.take_along_axis(bins, grp_row[None, :],
-                                     axis=0)[0].astype(jnp.int32)
+        def row_field(per_k):
+            # HIGHEST precision: default TPU matmul rounds operands to
+            # bf16 (8 mantissa bits), which would corrupt integer fields
+            # > 256 — group ids, new leaf ids, bin offsets
+            return jax.lax.dot(matchf, per_k.astype(jnp.float32),
+                               precision=jax.lax.Precision.HIGHEST)  # [N]
+
+        def row_field_i(per_k):
+            return row_field(per_k).astype(jnp.int32)
+
+        grp_row = row_field_i(tables.group[f_k])
+        # bins[grp_row[n], n] without a gather: compare-select over the G
+        # group rows (G*N elementwise beats an N-sized row-varying gather)
+        gb_row = jnp.sum(
+            jnp.where(jnp.arange(G)[:, None] == grp_row[None, :], bins, 0),
+            axis=0, dtype=jnp.int32)
         go_left = _decide_go_left(
-            gb_row, row_field(thresh_k), row_field(defl_k),
-            row_field(tables.missing_type[f_k]),
-            row_field(tables.default_bin[f_k]),
-            row_field(tables.nbins[f_k]), row_field(tables.lo[f_k]),
-            row_field(tables.hi[f_k]), row_field(tables.is_efb[f_k]))
+            gb_row, row_field_i(thresh_k), row_field(defl_k) > 0.5,
+            row_field_i(tables.missing_type[f_k]),
+            row_field_i(tables.default_bin[f_k]),
+            row_field_i(tables.nbins[f_k]), row_field_i(tables.lo[f_k]),
+            row_field_i(tables.hi[f_k]),
+            row_field(tables.is_efb[f_k].astype(jnp.int32)) > 0.5)
 
         # --- one histogram pass: channel block 2k+0 = left of sel[k],
         #     2k+1 = right; rows outside the selection hit the dump slot
         slot2 = jnp.where(kvalid, kidx * 2 + (1 - go_left.astype(jnp.int32)),
                           2 * K)  # [N] in [0, 2K]
         if slots_kernel:
-            # in-kernel slot expansion (no [N, 2K*CH] HBM matrix); measured
-            # slightly SLOWER on v5e today (Mosaic lowers the per-tile
-            # concat poorly), hence opt-in — see pallas_histogram_slots
+            # in-kernel slot expansion: no [N, 2K*CH] HBM matrix (the XLA
+            # materialization profiled at ~18 ms/wave at 1M rows)
             from ..ops.hist_pallas import pallas_histogram_slots
 
             histK = pallas_histogram_slots(
                 bins.astype(jnp.int32), gh, slot2, num_bins, 2 * K,
                 quantized=quantized, f32=hist_force_f32())
         else:
-            oh = (slot2[:, None] == jnp.arange(2 * K)[None, :])  # [N, 2K]
-            if quantized:
-                ghK = (oh[:, :, None].astype(jnp.int8) * gh[:, None, :]
-                       ).reshape(-1, 2 * K * CH)
-            else:
-                ghK = (oh[:, :, None] * gh[:, None, :]).reshape(-1, 2 * K * CH)
-                if ghK_bf16:
-                    # Pallas computes in bf16 anyway; materializing the
-                    # slot-expanded matrix in bf16 halves its HBM round trip
-                    ghK = ghK.astype(jnp.bfloat16)
+            # flat 2D build: column c belongs to slot c//CH, channel c%CH
+            # (profiled: the 3D broadcast+reshape fused badly, and a bf16
+            # output made the fusion 2x SLOWER — keep operand dtype)
+            col_slot = jnp.arange(2 * K * CH) // CH  # [2K*CH]
+            ghK = jnp.where(slot2[:, None] == col_slot[None, :],
+                            jnp.tile(gh, (1, 2 * K)), zero_gh)
             histK = build_histogram(bins, ghK, num_bins,
                                     compute_dtype=gh_dtype)  # [G, B, 2K*CH]
         hists = histK.reshape(G, num_bins, 2 * K, CH)
@@ -322,8 +336,9 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
          _) = jax.lax.fori_loop(0, K, replay_step, rp0)
 
         # --- apply all committed partitions in one vectorized pass
-        com_row = kvalid & jnp.take(committed[:K], kidx)
-        rid_row = jnp.take(newids[:K], kidx)
+        # (masked matvecs again, not [K]-table gathers)
+        com_row = kvalid & (row_field(committed[:K]) > 0.5)
+        rid_row = row_field_i(newids[:K])
         leaf_id = jnp.where(com_row & ~go_left, rid_row, leaf_id)
         return leaf_id, depth, leaf_best, rec_store, n_cur, t
 
