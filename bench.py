@@ -90,14 +90,23 @@ def make_data(n_rows: int):
 
 
 def _auc(y, score) -> float:
-    """Rank-based AUC (no sklearn dependency)."""
+    """Mann-Whitney AUC with midranks for ties (tree scores tie often;
+    ordinal ranks would make the number order-dependent)."""
     import numpy as np
 
-    order = np.argsort(score)
+    score = np.asarray(score)
+    order = np.argsort(score, kind="stable")
+    sorted_s = score[order]
     ranks = np.empty(len(score))
-    ranks[order] = np.arange(1, len(score) + 1)
+    # average rank within each tied group
+    uniq, start, counts = np.unique(sorted_s, return_index=True,
+                                    return_counts=True)
+    del uniq
+    group_mid = start + (counts + 1) / 2.0  # 1-based midrank per group
+    grp = np.repeat(np.arange(len(start)), counts)
+    ranks[order] = group_mid[grp]
     pos = y > 0.5
-    n_pos, n_neg = pos.sum(), (~pos).sum()
+    n_pos, n_neg = int(pos.sum()), int((~pos).sum())
     if n_pos == 0 or n_neg == 0:
         return 0.5
     return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2)

@@ -8,15 +8,17 @@ generates each [TN, B] one-hot tile INSIDE the kernel (VMEM-resident, never
 touches HBM) and feeds the MXU directly, so HBM traffic drops to the
 irreducible G*N*(bins + gh) bytes:
 
-    grid (G/8, N/TN); per step, for each of the 8 groups in the block:
+    grid (G/GB, N/TN); per step, for each of the GB groups in the block:
         onehot[TN, B] = (bins_tile[g][:, None] == iota)   # VPU, VMEM only
-        out[g] += onehot^T @ gh_tile                      # MXU, [B, 3]
+        out[g] += gh_tile^T @ onehot                      # MXU, [CH, B]
 
-Groups are blocked by 8 because Mosaic requires the second-to-last block
-dim to be a multiple of 8 (or the full array dim) — a (1, TN) bins block
-fails to lower on real TPU hardware. The output block for a group-8 slab is
-revisited across the N tiles (TPU grids run sequentially), accumulating in
-VMEM; step 0 zero-initializes.
+GB is chosen per call by _group_block: as large as the output block fits
+comfortably in VMEM (32 -> 16 -> 8; bigger blocks amortize per-grid-step
+work), never below 8 — Mosaic requires the second-to-last block dim to be
+a multiple of 8 (or the full array dim); a (1, TN) bins block fails to
+lower on real TPU hardware. The output block for a group slab is revisited
+across the N tiles (TPU grids run sequentially), accumulating in VMEM;
+step 0 zero-initializes.
 
 Counterpart of the CUDA shared-memory scatter kernels
 (src/treelearner/cuda/cuda_histogram_constructor.cu:20-513) — same
@@ -38,10 +40,22 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 DEFAULT_TILE_ROWS = 1024  # best of {512, 1024, 2048, 4096} on v5e
-GROUP_BLOCK = 8  # Mosaic minimum for the second-to-last block dim
+MIN_GROUP_BLOCK = 8  # Mosaic minimum for the second-to-last block dim
 
 
-def _make_kernel(num_bins: int, tile_rows: int, compute_dtype, acc_dtype):
+def _group_block(n_channels: int, num_bins: int, acc_bytes: int = 4) -> int:
+    """Largest group block whose output block stays comfortably in VMEM.
+    Bigger blocks amortize the per-grid-step work (the slot-expanded
+    gradient build runs once per (block, tile)): 8 -> 32 measured +13%
+    end-to-end training throughput on v5e."""
+    for gb in (32, 16):
+        if gb * n_channels * num_bins * acc_bytes <= (4 << 20):
+            return gb
+    return MIN_GROUP_BLOCK
+
+
+def _make_kernel(num_bins: int, tile_rows: int, compute_dtype, acc_dtype,
+                 group_block: int):
     def kernel(bins_ref, gh_ref, out_ref):
         @pl.when(pl.program_id(1) == 0)
         def _init():
@@ -49,7 +63,7 @@ def _make_kernel(num_bins: int, tile_rows: int, compute_dtype, acc_dtype):
 
         gh = gh_ref[...].astype(compute_dtype)
         iota = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, num_bins), 1)
-        for gi in range(GROUP_BLOCK):  # unrolled: static VMEM indices
+        for gi in range(group_block):  # unrolled: static VMEM indices
             b = bins_ref[gi, :]  # [TN] int32
             onehot = (b[:, None] == iota).astype(compute_dtype)  # VMEM only
             # [CH, B] orientation: B rides the 128-lane dim. The [B, CH]
@@ -111,20 +125,21 @@ def pallas_histogram(bins: jax.Array, gh: jax.Array, num_bins: int,
     if pad:
         bins = jnp.pad(bins, ((0, 0), (0, pad)), constant_values=0)
         gh = jnp.pad(gh, ((0, pad), (0, 0)))  # zero gh => no contribution
-    g_blocks = max(-(-G // GROUP_BLOCK), 1)
-    g_pad = g_blocks * GROUP_BLOCK - G
+    GB = _group_block(CH, num_bins)
+    g_blocks = max(-(-G // GB), 1)
+    g_pad = g_blocks * GB - G
     if g_pad:  # padded groups accumulate into rows sliced off below
         bins = jnp.pad(bins, ((0, g_pad), (0, 0)), constant_values=0)
     out = pl.pallas_call(
-        _make_kernel(num_bins, tile_rows, compute_dtype, acc_dtype),
+        _make_kernel(num_bins, tile_rows, compute_dtype, acc_dtype, GB),
         grid=(g_blocks, n_tiles),
         in_specs=[
-            pl.BlockSpec((GROUP_BLOCK, tile_rows), lambda g, t: (g, t)),
+            pl.BlockSpec((GB, tile_rows), lambda g, t: (g, t)),
             pl.BlockSpec((tile_rows, CH), lambda g, t: (t, 0)),
         ],
-        out_specs=pl.BlockSpec((GROUP_BLOCK, CH, num_bins),
+        out_specs=pl.BlockSpec((GB, CH, num_bins),
                                lambda g, t: (g, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((g_blocks * GROUP_BLOCK, CH, num_bins),
+        out_shape=jax.ShapeDtypeStruct((g_blocks * GB, CH, num_bins),
                                        acc_dtype),
         interpret=interpret,
     )(bins, gh)
@@ -132,7 +147,7 @@ def pallas_histogram(bins: jax.Array, gh: jax.Array, num_bins: int,
 
 
 def _make_slots_kernel(num_bins: int, tile_rows: int, n_slots: int,
-                       ch: int, compute_dtype, acc_dtype):
+                       ch: int, compute_dtype, acc_dtype, group_block: int):
     SC = n_slots * ch
 
     def kernel(bins_ref, gh_ref, slot_ref, out_ref):
@@ -162,7 +177,7 @@ def _make_slots_kernel(num_bins: int, tile_rows: int, n_slots: int,
             gsum += ghb[:, c:c + 1] * (colch == c).astype(build_dtype)
         ghK = (gsum * (colslot == s).astype(build_dtype)).astype(compute_dtype)
         iota = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, num_bins), 1)
-        for gi in range(GROUP_BLOCK):
+        for gi in range(group_block):
             b = bins_ref[gi, :]
             onehot = (b[:, None] == iota).astype(compute_dtype)
             acc = jax.lax.dot_general(
@@ -211,22 +226,23 @@ def pallas_histogram_slots(bins: jax.Array, gh: jax.Array, slot: jax.Array,
         bins = jnp.pad(bins, ((0, 0), (0, pad)), constant_values=0)
         gh = jnp.pad(gh, ((0, pad), (0, 0)))  # zero gh => no contribution
         slot = jnp.pad(slot, ((0, pad), (0, 0)), constant_values=n_slots)
-    g_blocks = max(-(-G // GROUP_BLOCK), 1)
-    g_pad = g_blocks * GROUP_BLOCK - G
+    GB = _group_block(SC, num_bins)
+    g_blocks = max(-(-G // GB), 1)
+    g_pad = g_blocks * GB - G
     if g_pad:
         bins = jnp.pad(bins, ((0, g_pad), (0, 0)), constant_values=0)
     out = pl.pallas_call(
         _make_slots_kernel(num_bins, tile_rows, n_slots, CH, compute_dtype,
-                           acc_dtype),
+                           acc_dtype, GB),
         grid=(g_blocks, n_tiles),
         in_specs=[
-            pl.BlockSpec((GROUP_BLOCK, tile_rows), lambda g, t: (g, t)),
+            pl.BlockSpec((GB, tile_rows), lambda g, t: (g, t)),
             pl.BlockSpec((tile_rows, CH), lambda g, t: (t, 0)),
             pl.BlockSpec((tile_rows, 1), lambda g, t: (t, 0)),
         ],
-        out_specs=pl.BlockSpec((GROUP_BLOCK, SC, num_bins),
+        out_specs=pl.BlockSpec((GB, SC, num_bins),
                                lambda g, t: (g, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((g_blocks * GROUP_BLOCK, SC, num_bins),
+        out_shape=jax.ShapeDtypeStruct((g_blocks * GB, SC, num_bins),
                                        acc_dtype),
         interpret=interpret,
     )(bins, gh, slot)

@@ -221,35 +221,37 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         kvalid = match.any(axis=1)
         kidx = jnp.argmax(match, axis=1).astype(jnp.int32)  # [N], junk if !kvalid
 
-        # per-row split fields as masked matvecs over the [N, K] match —
-        # vectorized VPU/MXU work; jnp.take gathers here measured far
-        # slower (TPU gathers serialize). Field values are small ints,
-        # exact in f32.
+        # per-row split fields as ONE masked [N,K]@[K,9] matmul over the
+        # match matrix — vectorized VPU/MXU work; jnp.take gathers here
+        # measured far slower (TPU gathers serialize), and separate
+        # per-field matvecs would re-read the [N, K] matrix from HBM nine
+        # times. Field values are small ints, exact in f32. HIGHEST
+        # precision: default TPU matmul rounds operands to bf16 (8 mantissa
+        # bits), which would corrupt integer fields > 256 — group ids, new
+        # leaf ids, bin offsets.
         matchf = match.astype(jnp.float32)
 
-        def row_field(per_k):
-            # HIGHEST precision: default TPU matmul rounds operands to
-            # bf16 (8 mantissa bits), which would corrupt integer fields
-            # > 256 — group ids, new leaf ids, bin offsets
-            return jax.lax.dot(matchf, per_k.astype(jnp.float32),
-                               precision=jax.lax.Precision.HIGHEST)  # [N]
+        def rows_of(per_k_fields):  # [K, F] -> [N, F]
+            return jax.lax.dot(matchf, per_k_fields.astype(jnp.float32),
+                               precision=jax.lax.Precision.HIGHEST)
 
-        def row_field_i(per_k):
-            return row_field(per_k).astype(jnp.int32)
-
-        grp_row = row_field_i(tables.group[f_k])
+        fields = jnp.stack([
+            tables.group[f_k], thresh_k, defl_k.astype(jnp.int32),
+            tables.missing_type[f_k], tables.default_bin[f_k],
+            tables.nbins[f_k], tables.lo[f_k], tables.hi[f_k],
+            tables.is_efb[f_k].astype(jnp.int32),
+        ], axis=1)  # [K, 9]
+        rowsF = rows_of(fields)  # [N, 9]
+        ri = rowsF.astype(jnp.int32)
+        grp_row = ri[:, 0]
         # bins[grp_row[n], n] without a gather: compare-select over the G
         # group rows (G*N elementwise beats an N-sized row-varying gather)
         gb_row = jnp.sum(
             jnp.where(jnp.arange(G)[:, None] == grp_row[None, :], bins, 0),
             axis=0, dtype=jnp.int32)
         go_left = _decide_go_left(
-            gb_row, row_field_i(thresh_k), row_field(defl_k) > 0.5,
-            row_field_i(tables.missing_type[f_k]),
-            row_field_i(tables.default_bin[f_k]),
-            row_field_i(tables.nbins[f_k]), row_field_i(tables.lo[f_k]),
-            row_field_i(tables.hi[f_k]),
-            row_field(tables.is_efb[f_k].astype(jnp.int32)) > 0.5)
+            gb_row, ri[:, 1], rowsF[:, 2] > 0.5, ri[:, 3], ri[:, 4],
+            ri[:, 5], ri[:, 6], ri[:, 7], rowsF[:, 8] > 0.5)
 
         # --- one histogram pass: channel block 2k+0 = left of sel[k],
         #     2k+1 = right; rows outside the selection hit the dump slot
@@ -336,9 +338,12 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
          _) = jax.lax.fori_loop(0, K, replay_step, rp0)
 
         # --- apply all committed partitions in one vectorized pass
-        # (masked matvecs again, not [K]-table gathers)
-        com_row = kvalid & (row_field(committed[:K]) > 0.5)
-        rid_row = row_field_i(newids[:K])
+        # (one stacked masked matmul again, not [K]-table gathers)
+        post = jnp.stack([committed[:K].astype(jnp.int32), newids[:K]],
+                         axis=1)  # [K, 2]
+        rowsP = rows_of(post)  # [N, 2]
+        com_row = kvalid & (rowsP[:, 0] > 0.5)
+        rid_row = rowsP[:, 1].astype(jnp.int32)
         leaf_id = jnp.where(com_row & ~go_left, rid_row, leaf_id)
         return leaf_id, depth, leaf_best, rec_store, n_cur, t
 

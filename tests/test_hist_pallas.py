@@ -63,6 +63,45 @@ def test_pallas_histogram_slots(rng):
                                    rtol=1e-5, atol=1e-4)
 
 
+def test_pallas_histogram_slots_bf16_default(rng):
+    """The default TPU wave path: bf16 operands, f32 accumulation."""
+    from lightgbm_tpu.ops.hist_pallas import pallas_histogram_slots
+
+    G, B, n, S = 3, 16, 4000, 4
+    bins = rng.randint(0, B, size=(G, n)).astype(np.int32)
+    gh = rng.randn(n, 3).astype(np.float32)
+    slot = rng.randint(0, S + 2, size=n).astype(np.int32)
+    ours = np.asarray(pallas_histogram_slots(
+        jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(slot), B, S,
+        interpret=True))
+    assert ours.dtype == np.float32
+    for s in range(S):
+        ref = _ref_hist(bins, np.where((slot == s)[:, None], gh, 0.0), B)
+        np.testing.assert_allclose(ours[..., s * 3:(s + 1) * 3], ref,
+                                   rtol=2e-2, atol=2e-1)
+
+
+def test_pallas_histogram_slots_quantized_exact(rng):
+    """Quantized wave path: int32 in-kernel build, int8 matmul operands,
+    exact int32 accumulation."""
+    from lightgbm_tpu.ops.hist_pallas import pallas_histogram_slots
+
+    G, B, n, S = 3, 16, 4000, 4
+    bins = rng.randint(0, B, size=(G, n)).astype(np.int32)
+    gh = np.stack([rng.randint(-4, 5, n), rng.randint(0, 6, n),
+                   np.ones(n)], axis=1).astype(np.int8)
+    slot = rng.randint(0, S + 2, size=n).astype(np.int32)
+    ours = np.asarray(pallas_histogram_slots(
+        jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(slot), B, S,
+        quantized=True, interpret=True))
+    assert ours.dtype == np.int32
+    for s in range(S):
+        ref = _ref_hist(bins, np.where((slot == s)[:, None],
+                                       gh.astype(np.int64), 0), B)
+        np.testing.assert_array_equal(ours[..., s * 3:(s + 1) * 3],
+                                      ref.astype(np.int64))
+
+
 def test_pallas_histogram_quantized_exact(rng):
     G, B, n = 4, 32, 5000
     bins = rng.randint(0, B, size=(G, n)).astype(np.int32)
