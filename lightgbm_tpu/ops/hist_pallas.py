@@ -43,13 +43,17 @@ DEFAULT_TILE_ROWS = 1024  # best of {512, 1024, 2048, 4096} on v5e
 MIN_GROUP_BLOCK = 8  # Mosaic minimum for the second-to-last block dim
 
 
-def _group_block(n_channels: int, num_bins: int, acc_bytes: int = 4) -> int:
-    """Largest group block whose output block stays comfortably in VMEM.
-    Bigger blocks amortize the per-grid-step work (the slot-expanded
+def _group_block(n_groups: int, n_channels: int, num_bins: int,
+                 acc_bytes: int = 4) -> int:
+    """Largest useful group block whose output block stays comfortably in
+    VMEM. Bigger blocks amortize the per-grid-step work (the slot-expanded
     gradient build runs once per (block, tile)): 8 -> 32 measured +13%
-    end-to-end training throughput on v5e."""
+    end-to-end training throughput on v5e. Clamped to the group count
+    rounded up to 8 so small-G datasets don't pay for dead padded groups."""
+    cap = max(-(-n_groups // MIN_GROUP_BLOCK) * MIN_GROUP_BLOCK,
+              MIN_GROUP_BLOCK)
     for gb in (32, 16):
-        if gb * n_channels * num_bins * acc_bytes <= (4 << 20):
+        if gb <= cap and gb * n_channels * num_bins * acc_bytes <= (4 << 20):
             return gb
     return MIN_GROUP_BLOCK
 
@@ -125,7 +129,7 @@ def pallas_histogram(bins: jax.Array, gh: jax.Array, num_bins: int,
     if pad:
         bins = jnp.pad(bins, ((0, 0), (0, pad)), constant_values=0)
         gh = jnp.pad(gh, ((0, pad), (0, 0)))  # zero gh => no contribution
-    GB = _group_block(CH, num_bins)
+    GB = _group_block(G, CH, num_bins)
     g_blocks = max(-(-G // GB), 1)
     g_pad = g_blocks * GB - G
     if g_pad:  # padded groups accumulate into rows sliced off below
@@ -226,7 +230,7 @@ def pallas_histogram_slots(bins: jax.Array, gh: jax.Array, slot: jax.Array,
         bins = jnp.pad(bins, ((0, 0), (0, pad)), constant_values=0)
         gh = jnp.pad(gh, ((0, pad), (0, 0)))  # zero gh => no contribution
         slot = jnp.pad(slot, ((0, pad), (0, 0)), constant_values=n_slots)
-    GB = _group_block(SC, num_bins)
+    GB = _group_block(G, SC, num_bins)
     g_blocks = max(-(-G // GB), 1)
     g_pad = g_blocks * GB - G
     if g_pad:
