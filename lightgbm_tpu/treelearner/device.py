@@ -3,34 +3,32 @@
 The host-driven SerialTreeLearner pays per-split dispatch latency (3 calls +
 2 blocking scalar pulls), which dominates wall-clock on a remote-attached
 TPU. This learner instead grows the ENTIRE tree inside a single jitted
-function: a `lax.fori_loop` over num_leaves-1 split steps carrying
+function: a `lax.while_loop` over speculative WAVES carrying
 
     leaf_id    [N]          per-row leaf assignment (bagged-out rows = -1)
     leaf_best  [L+1,R]      per-leaf packed best-split records
     depth      [L+1]        per-leaf depth
     rec_store  [L,R+4]      the split log the host replays into a Tree
 
-Per step: argmax over leaf gains -> partition by leaf-id rewrite (the
-CUDADataPartition idea without compaction) -> BOTH child histograms in one
-6-channel masked full-N one-hot MXU contraction -> two split scans. All
+Per wave: top-K frontier leaves by gain -> BOTH children's histograms for
+all K in ONE 2*K*3-channel masked full-N one-hot MXU contraction (Pallas,
+ops/hist_pallas.py) -> 2K split scans -> an on-device replay that commits
+splits in exact best-first order until the argmax needs a leaf whose
+children were not precomputed (see grow_tree_on_device's docstring). All
 shapes are static; the only host traffic per TREE is the split log + final
-leaf ids. On the MXU a full-N histogram costs ~milliseconds of compute, so
-trading the reference's O(leaf_rows) index gathers (dense_bin.hpp
-ConstructHistogram) for O(N) static-shape masked work buys a 254x reduction
-in round trips at negligible FLOP cost.
+leaf ids.
 
-Design note — no histogram pool, no subtraction trick: in this full-N
-masked formulation a child histogram costs the same whether the leaf holds
-10 rows or all of them, so `parent - sibling` (FeatureHistogram::Subtract)
-saves nothing; worse, a [L+1, G, B, 3] pool carried through the fori_loop
-defeats XLA's in-place buffer analysis once a Pallas call sits in the loop
-body (measured ~10 ms/split of copy traffic — 20x the histogram itself).
-Computing left+right directly as channels [gL,hL,cL,gR,hR,cR] of ONE
-contraction deletes the pool, the subtraction, and the copies.
-
-Conditional no-op steps (no positive gain left) write to the dump row L, so
-the loop body stays branch-free (tree.h leaf-wise semantics preserved:
-growth stops exactly when the best gain <= 0; the host replay cuts there).
+Design notes, each measured on hardware:
+  * No histogram pool, no subtraction trick: with full-N masked histograms
+    a child costs the same either way, and a [L+1, G, B, 3] pool carried
+    through the loop defeats XLA's in-place buffer analysis once a Pallas
+    call sits in the body (~10 ms/split of copies).
+  * Row routing (which leaf/slot owns a row, split decision fields, commit
+    application) is all compares and masked [N,K]@[K,F] matmuls — TPU
+    gathers serialize, elementwise compares and matmuls vectorize.
+  * The wave replay keeps the reference's leaf-wise semantics bit-exact
+    (tree.h best-first; growth stops when the best gain <= 0; masked no-op
+    steps write to dump rows so the loop body stays branch-free).
 
 Counterpart of SerialTreeLearner::Train + CUDASingleGPUTreeLearner::Train
 (serial_tree_learner.cpp:182, cuda_single_gpu_tree_learner.cpp:169-360).
