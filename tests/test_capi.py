@@ -96,6 +96,70 @@ def test_capi_end_to_end(capi, tmp_path):
     _check(lib, lib.LGBM_DatasetFree(ds))
 
 
+def test_capi_model_string_roundtrip_and_predict_types(capi):
+    lib = capi
+    rng = np.random.RandomState(1)
+    n, f = 400, 5
+    X = rng.randn(n, f).astype(np.float64)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, b"max_bin=63",
+        None, ctypes.byref(ds)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), n, 0))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1 device_type=cpu",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(5):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    # SaveModelToString: first call with a small buffer to learn the size
+    out_len = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterSaveModelToString(
+        bst, 0, -1, 0, ctypes.c_int64(8), ctypes.byref(out_len),
+        ctypes.create_string_buffer(8)))
+    size = out_len.value
+    assert size > 100
+    buf = ctypes.create_string_buffer(size)
+    _check(lib, lib.LGBM_BoosterSaveModelToString(
+        bst, 0, -1, 0, ctypes.c_int64(size), ctypes.byref(out_len), buf))
+    model_str = buf.value
+    assert model_str.startswith(b"tree")
+
+    nit = ctypes.c_int()
+    bst2 = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterLoadModelFromString(
+        model_str, ctypes.byref(nit), ctypes.byref(bst2)))
+    assert nit.value == 5
+
+    # predict types: raw (1), leaf index (2), contrib (3)
+    raw = np.zeros(n)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst2, X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, 1, 0, 0, b"",
+        ctypes.byref(out_len), raw.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert out_len.value == n and np.isfinite(raw).all()
+    leaves = np.zeros(n * 5)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst2, X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, 2, 0, 0, b"",
+        ctypes.byref(out_len), leaves.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert out_len.value == n * 5
+    assert leaves.min() >= 0 and leaves.max() < 7
+    contrib = np.zeros(n * (f + 1))
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst2, X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, 3, 0, 0, b"",
+        ctypes.byref(out_len), contrib.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert out_len.value == n * (f + 1)
+    # SHAP contributions sum to the raw score
+    np.testing.assert_allclose(contrib.reshape(n, f + 1).sum(axis=1), raw,
+                               rtol=1e-4, atol=1e-5)
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_BoosterFree(bst2))
+    _check(lib, lib.LGBM_DatasetFree(ds))
+
+
 def test_capi_error_reporting(capi):
     lib = capi
     bad = ctypes.c_void_p(999999)
