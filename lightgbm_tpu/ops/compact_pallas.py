@@ -1,0 +1,280 @@
+"""Pallas TPU leaf-contiguous row compaction (stable 2-way partition).
+
+The device tree learner keeps every per-row array (bin columns + gradient
+rows) in a LEAF-CONTIGUOUS permutation so each histogram wave can read only
+the rows of the leaves it is splitting (ops/hist_pallas.py ragged tiles)
+instead of all N rows. This module moves the rows: given the forward
+destination map of a stable 2-way partition restricted to a set of disjoint
+leaf ranges, it produces the re-permuted arrays in one sequential-grid
+Pallas pass.
+
+Counterpart of CUDADataPartition::SplitInner (cuda_data_partition.cu):
+there, a bitvector + block prefix-scan + global scatter. TPUs have no fast
+global scatter, so the same data movement is phrased as dense tile algebra:
+
+  1. XLA side (range_partition_dst): per-range stable left/right ranks via
+     two global exclusive scans + a [N, K] range-membership matmul for the
+     per-row destination base -> forward map dst[j] (a permutation of
+     [0, N); rows outside every range keep their position).
+  2. XLA side (build_pair_tables): each INPUT tile's rows land in at most a
+     handful of OUTPUT tiles — per (range, side) the destinations are
+     contiguous, so a tile's class rows span <= 2 output tiles. The pair
+     list (in_tile -> out_tile), sorted by out_tile, is the kernel's grid.
+  3. Pallas kernel (pallas_compact): sequential grid over pairs; per pair
+     build the in-tile one-hot P[i, o] = (dst[i] - out*T == o) and
+     accumulate out_block += P^T @ rows (and bins @ P). Consecutive pairs
+     share the output block (sorted order), so accumulation stays in VMEM;
+     a scalar-prefetched copy flag routes untouched tiles through a plain
+     VPU copy with no matmul.
+
+Exactness: values transit the MXU as four 8-bit limbs of their raw bits
+(bf16 operands — 0/1 one-hot and limbs <= 255 are exact in bf16, and each
+output row receives exactly ONE source row), so arbitrary f32/int32 payloads
+are moved bit-exactly at full bf16 MXU rate. No lax.sort anywhere: at 10.5M
+rows a global sort costs more than the histograms it would save
+(docs/PERF_NOTES.md).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Compaction tile: independent of the histogram tile (DEFAULT_TILE_ROWS);
+# the one-hot P is [tile, tile] so smaller tiles keep VMEM + per-pair FLOPs
+# down. N must be padded to a multiple of lcm(COMPACT_TILE, hist tile).
+COMPACT_TILE = 512
+
+
+def exclusive_cumsum(x: jax.Array) -> jax.Array:
+    """[N] -> [N] exclusive prefix sum (int32)."""
+    x = x.astype(jnp.int32)
+    return jnp.cumsum(x) - x
+
+
+def range_partition_dst(go_left: jax.Array, match: jax.Array,
+                        starts: jax.Array, counts: jax.Array,
+                        valid: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Forward destination map of a stable 2-way partition of K disjoint
+    position ranges.
+
+    go_left [N] bool, match [N, K] bool (row-in-range membership, already
+    masked by `valid`), starts/counts [K] int32, valid [K] bool.
+    Returns (dst [N] int32, n_left [K] int32). Rows outside every valid
+    range keep their position; rows of range k land stably in
+    [starts[k], starts[k]+n_left[k]) or [starts[k]+n_left[k], ends[k]).
+
+    All vectorized: two global scans, K-sized gathers, one [N, K] matmul
+    for the per-row base (gathers at N scale serialize on TPU; the matmul
+    does not). Positions must be < 2**24 (exact in f32).
+    """
+    N, K = match.shape
+    pos = jnp.arange(N, dtype=jnp.int32)
+    in_any = match.any(axis=1)
+    lmask = in_any & go_left
+    rmask = in_any & ~go_left
+    lcum = exclusive_cumsum(lmask)
+    rcum = exclusive_cumsum(rmask)
+    # length-(N+1) inclusive tails so ends[k] == N indexes safely
+    lext = jnp.concatenate(
+        [lcum, (lcum[-1] + lmask[-1].astype(jnp.int32))[None]])
+    rext = jnp.concatenate(
+        [rcum, (rcum[-1] + rmask[-1].astype(jnp.int32))[None]])
+    ends = starts + counts
+    n_left = jnp.take(lext, ends) - jnp.take(lext, starts)
+    base_l = starts - jnp.take(lext, starts)
+    base_r = starts + n_left - jnp.take(rext, starts)
+    bases = jax.lax.dot(match.astype(jnp.float32),
+                        jnp.stack([base_l, base_r], axis=1)
+                        .astype(jnp.float32),
+                        precision=jax.lax.Precision.HIGHEST)  # [N, 2]
+    dst = jnp.where(
+        lmask, bases[:, 0].astype(jnp.int32) + lcum,
+        jnp.where(rmask, bases[:, 1].astype(jnp.int32) + rcum, pos))
+    return dst, jnp.where(valid, n_left, 0)
+
+
+def max_pairs_bound(n_tiles: int, n_classes: int) -> int:
+    """Static upper bound on the pair-list length for DISJOINT class masks.
+
+    identity pairs: n_tiles. Per class, (in_tile, out_tile) adjacencies of a
+    contiguous destination run <= tiles_touched + out_tiles; summed over
+    disjoint classes both terms are <= n_tiles + 2*n_classes.
+    """
+    return 3 * n_tiles + 4 * n_classes + 8
+
+
+def build_pair_tables(dst: jax.Array, class_masks: Sequence[jax.Array],
+                      moved: jax.Array, tile: int):
+    """Pair list (in_tile -> out_tile) covering every row movement.
+
+    dst [N] int32 forward permutation; class_masks: disjoint row sets whose
+    destinations are contiguous PER TILE (e.g. left rows of one range);
+    moved [N] bool = union of class masks (rows whose dst may differ from
+    their position). Returns (pair_in, pair_out, is_copy, n_pairs[1]) with
+    static length max_pairs_bound(T, len(class_masks)); entries past
+    n_pairs repeat the last real pair (same blocks -> the kernel skips DMA
+    and compute for them). Sorted by out_tile so the kernel revisits each
+    output block in one consecutive run.
+    """
+    N = dst.shape[0]
+    T = N // tile
+    if T * T + T >= 2 ** 30:
+        raise ValueError("pair sort key would overflow int32; use a larger "
+                         "compaction tile for this row count")
+    dstT = dst.reshape(T, tile)
+    big = jnp.int32(2 ** 30)
+    ids = jnp.arange(T, dtype=jnp.int32)
+    cands = [ids[:, None]]  # identity pair for every tile: full coverage
+    for m in class_masks:
+        mT = m.reshape(T, tile)
+        any_m = mT.any(axis=1)
+        dmin = jnp.min(jnp.where(mT, dstT, big), axis=1) // tile
+        dmax = jnp.max(jnp.where(mT, dstT, -1), axis=1) // tile
+        c0 = jnp.where(any_m, dmin, T)
+        c1 = jnp.where(any_m & (dmax > dmin), dmax, T)
+        cands.append(jnp.stack([c0, c1], axis=1))
+    cand = jnp.concatenate(cands, axis=1)  # [T, 1 + 2*len(masks)]
+    # de-duplicate per input tile (duplicate pairs would double-count rows)
+    cs = jnp.sort(cand, axis=1)
+    dup = jnp.concatenate([jnp.zeros((T, 1), bool), cs[:, 1:] == cs[:, :-1]],
+                          axis=1)
+    cs = jnp.where(dup | (cs >= T), T, cs)
+    out_flat = cs.reshape(-1)
+    in_flat = jnp.repeat(ids, cs.shape[1])
+    ok = out_flat < T
+    key = jnp.where(ok, out_flat * T + in_flat, big)
+    key = jax.lax.sort(key)
+    n_pairs = ok.sum().astype(jnp.int32)
+    mp = max_pairs_bound(T, len(class_masks))
+    if key.shape[0] < mp:
+        key = jnp.concatenate([key, jnp.full(mp - key.shape[0], big,
+                                             jnp.int32)])
+    key = key[:mp]
+    last = jnp.take(key, jnp.maximum(n_pairs - 1, 0))
+    key = jnp.where(jnp.arange(mp) < n_pairs, key, last)
+    pair_in = key % T
+    pair_out = key // T
+    # untouched tiles: identity pair does a raw block copy, no matmul.
+    # (A tile receiving rows from elsewhere necessarily lost rows too —
+    # dst is a permutation — so untouched tiles exchange nothing.)
+    touched = moved.reshape(T, tile).any(axis=1)
+    is_copy = ((pair_in == pair_out)
+               & ~jnp.take(touched, pair_in)).astype(jnp.int32)
+    return pair_in, pair_out, is_copy, n_pairs[None]
+
+
+def _limbs(x_int: jax.Array, n: int, axis: int) -> jax.Array:
+    """Split int32 values into n 8-bit limbs concatenated along `axis`
+    (each limb <= 255: exact as a bf16 matmul operand)."""
+    parts = [jnp.bitwise_and(jax.lax.shift_right_logical(x_int, 8 * i), 255)
+             for i in range(n)]
+    return jnp.concatenate(parts, axis=axis)
+
+
+def _make_compact_kernel(tile: int, gp: int, rc: int):
+    def kernel(pin_ref, pout_ref, pcopy_ref, npair_ref,
+               bins_ref, row_ref, dst_ref, bins_out, row_out):
+        p = pl.program_id(0)
+        out_t = pout_ref[p]
+        first = (p == 0) | (out_t != pout_ref[jnp.maximum(p - 1, 0)])
+        active = p < npair_ref[0]
+        is_copy = pcopy_ref[p] > 0
+
+        @pl.when(active & is_copy)
+        def _copy():  # untouched tile: single pair for this block, plain copy
+            bins_out[...] = bins_ref[...]
+            row_out[...] = row_ref[...]
+
+        @pl.when(active & jnp.logical_not(is_copy))
+        def _permute():
+            @pl.when(first)
+            def _zero():
+                bins_out[...] = jnp.zeros_like(bins_out)
+                row_out[...] = jnp.zeros_like(row_out)
+
+            rel = dst_ref[...][:, 0] - out_t * tile  # [tile] int32
+            iota = jax.lax.broadcasted_iota(jnp.int32, (tile, tile), 1)
+            # P[i, o] = 1 iff in-row i lands at out-row o of this block.
+            # dst is injective => every column has at most one 1, so each
+            # output row below receives exactly one source row: the limb
+            # matmuls are exact bit transport, not sums.
+            P = (rel[:, None] == iota).astype(jnp.bfloat16)
+            rbits = jax.lax.bitcast_convert_type(row_ref[...], jnp.int32)
+            rl = _limbs(rbits, 4, axis=1).astype(jnp.bfloat16)  # [tile, 4*rc]
+            orl = jax.lax.dot_general(
+                P, rl, dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(jnp.int32)
+            obits = (orl[:, :rc]
+                     | (orl[:, rc:2 * rc] << 8)
+                     | (orl[:, 2 * rc:3 * rc] << 16)
+                     | (orl[:, 3 * rc:] << 24))
+            # rows not sourced by this pair recombine to bits 0 == +0.0f;
+            # f32 += 0.0 is exact, so cross-pair accumulation is bit-exact
+            row_out[...] += jax.lax.bitcast_convert_type(obits, jnp.float32)
+            bl = _limbs(bins_ref[...], 2, axis=0).astype(jnp.bfloat16)
+            obl = jax.lax.dot_general(
+                bl, P, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(jnp.int32)
+            bins_out[...] += obl[:gp] | (obl[gp:] << 8)
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("tile", "interpret"))
+def _pallas_compact_call(bins_p, row_p, dst, pair_in, pair_out, is_copy,
+                         n_pairs, tile: int, interpret: bool):
+    Gp, N = bins_p.shape
+    rc = row_p.shape[1]
+    mp = pair_in.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(mp,),
+        in_specs=[
+            pl.BlockSpec((Gp, tile), lambda p, pi, po, pc, npr: (0, pi[p])),
+            pl.BlockSpec((tile, rc), lambda p, pi, po, pc, npr: (pi[p], 0)),
+            pl.BlockSpec((tile, 1), lambda p, pi, po, pc, npr: (pi[p], 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((Gp, tile), lambda p, pi, po, pc, npr: (0, po[p])),
+            pl.BlockSpec((tile, rc), lambda p, pi, po, pc, npr: (po[p], 0)),
+        ],
+    )
+    return pl.pallas_call(
+        _make_compact_kernel(tile, Gp, rc),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((Gp, N), jnp.int32),
+            jax.ShapeDtypeStruct((N, rc), jnp.float32),
+        ],
+        interpret=interpret,
+    )(pair_in, pair_out, is_copy, n_pairs, bins_p, row_p,
+      dst.reshape(N, 1))
+
+
+def compact_rows(bins_p: jax.Array, row_p: jax.Array, dst: jax.Array,
+                 class_masks: Sequence[jax.Array], moved: jax.Array,
+                 *, tile: int = COMPACT_TILE, use_pallas: bool = True,
+                 interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """Apply the forward permutation dst to bins_p [Gp, N] (int32,
+    values < 2**16) and row_p [N, rc] (f32 payload, moved bit-exactly).
+
+    Pallas path requirements: N % tile == 0, Gp % 8 == 0, class_masks
+    disjoint with per-tile-contiguous destinations (range_partition_dst
+    output qualifies), moved == union(class_masks). The XLA path is a plain
+    permutation scatter — exact on CPU, used when no TPU backend is live.
+    """
+    if not use_pallas:
+        bins_o = jnp.zeros_like(bins_p).at[:, dst].set(
+            bins_p, unique_indices=True)
+        row_o = jnp.zeros_like(row_p).at[dst].set(row_p, unique_indices=True)
+        return bins_o, row_o
+    pair_in, pair_out, is_copy, n_pairs = build_pair_tables(
+        dst, class_masks, moved, tile)
+    return _pallas_compact_call(bins_p, row_p.astype(jnp.float32),
+                                dst.astype(jnp.int32), pair_in, pair_out,
+                                is_copy, n_pairs, tile, interpret)

@@ -1,0 +1,146 @@
+"""Leaf-contiguous compaction: forward-map helper + Pallas pair kernel
+(interpret mode on CPU) vs the argsort-stable partition oracle, bit-exact."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.compact_pallas import (
+    COMPACT_TILE, build_pair_tables, compact_rows, max_pairs_bound,
+    range_partition_dst)
+
+
+def _np_dst(go_left, ranges, n):
+    """Stable 2-way partition forward map, built from the argsort oracle:
+    within each range, rows ordered by (right-flag, original position)."""
+    dst = np.arange(n)
+    for s, c in ranges:
+        order = np.argsort(~go_left[s:s + c], kind="stable") + s  # old idx
+        dst[order] = np.arange(s, s + c)
+    return dst
+
+
+def _masks(go_left, ranges, n):
+    match = np.zeros((n, len(ranges)), dtype=bool)
+    for k, (s, c) in enumerate(ranges):
+        match[s:s + c, k] = True
+    cm = [match[:, k] & go_left for k in range(len(ranges))]
+    cm += [match[:, k] & ~go_left for k in range(len(ranges))]
+    return match, cm
+
+
+def _dst(go_left, ranges, n):
+    match, cm = _masks(go_left, ranges, n)
+    starts = jnp.asarray([s for s, _ in ranges], jnp.int32)
+    counts = jnp.asarray([c for _, c in ranges], jnp.int32)
+    valid = jnp.ones(len(ranges), bool)
+    dst, n_left = range_partition_dst(
+        jnp.asarray(go_left), jnp.asarray(match), starts, counts, valid)
+    return np.asarray(dst), np.asarray(n_left), cm, match
+
+
+CASES = [
+    ("multi", [(64, 300), (512, 512), (1100, 180), (1280, 250)]),
+    ("adjacent_tiny", [(0, 7), (7, 9), (16, 3), (19, 501)]),
+    ("tile_aligned", [(0, 512), (1024, 512)]),
+    ("full", [(0, 2048)]),
+]
+
+
+@pytest.mark.parametrize("name,ranges", CASES)
+def test_range_partition_dst_matches_oracle(rng, name, ranges):
+    n = 2048
+    go_left = rng.rand(n) < 0.4
+    dst, n_left, _, _ = _dst(go_left, ranges, n)
+    np.testing.assert_array_equal(dst, _np_dst(go_left, ranges, n))
+    for k, (s, c) in enumerate(ranges):
+        assert n_left[k] == go_left[s:s + c].sum()
+
+
+@pytest.mark.parametrize("name,ranges", CASES)
+@pytest.mark.parametrize("tile", [256, 512])
+def test_compact_pallas_bit_exact(rng, name, ranges, tile):
+    n, gp, rc = 2048, 8, 5
+    go_left = rng.rand(n) < 0.5
+    dst, _, cm, match = _dst(go_left, ranges, n)
+    bins = rng.randint(0, 60000, size=(gp, n)).astype(np.int32)
+    row = rng.randn(n, rc).astype(np.float32)
+    row[:, 3] = np.arange(n)  # a perm-style integer column rides along
+    moved = match.any(axis=1)
+    ours_b, ours_r = compact_rows(
+        jnp.asarray(bins), jnp.asarray(row), jnp.asarray(dst),
+        [jnp.asarray(m) for m in cm], jnp.asarray(moved),
+        tile=tile, use_pallas=True, interpret=True)
+    ref_b = np.zeros_like(bins)
+    ref_b[:, dst] = bins
+    ref_r = np.zeros_like(row)
+    ref_r[dst] = row
+    np.testing.assert_array_equal(np.asarray(ours_b), ref_b)
+    # bit-exact: limb transport must preserve f32 payloads exactly
+    np.testing.assert_array_equal(
+        np.asarray(ours_r).view(np.uint32), ref_r.view(np.uint32))
+
+
+def test_compact_xla_fallback_exact(rng):
+    n, gp, rc = 1024, 3, 5
+    ranges = [(100, 500), (700, 300)]
+    go_left = rng.rand(n) < 0.3
+    dst, _, cm, match = _dst(go_left, ranges, n)
+    bins = rng.randint(0, 256, size=(gp, n)).astype(np.int32)
+    row = rng.randn(n, rc).astype(np.float32)
+    ours_b, ours_r = compact_rows(
+        jnp.asarray(bins), jnp.asarray(row), jnp.asarray(dst),
+        [jnp.asarray(m) for m in cm], jnp.asarray(match.any(axis=1)),
+        use_pallas=False)
+    ref_b = np.zeros_like(bins)
+    ref_b[:, dst] = bins
+    ref_r = np.zeros_like(row)
+    ref_r[dst] = row
+    np.testing.assert_array_equal(np.asarray(ours_b), ref_b)
+    np.testing.assert_array_equal(np.asarray(ours_r), ref_r)
+
+
+def test_compact_one_sided(rng):
+    """Empty-left and empty-right partitions stay identity permutations."""
+    n, tile = 1024, 256
+    for flag in (True, False):
+        go_left = np.full(n, flag)
+        ranges = [(0, 600)]
+        dst, n_left, cm, match = _dst(go_left, ranges, n)
+        np.testing.assert_array_equal(dst, np.arange(n))
+        assert n_left[0] == (600 if flag else 0)
+        bins = np.arange(2 * n, dtype=np.int32).reshape(2, n) % 256
+        bins = np.vstack([bins] * 4)  # gp=8
+        row = np.arange(n * 5, dtype=np.float32).reshape(n, 5)
+        ob, orr = compact_rows(
+            jnp.asarray(bins), jnp.asarray(row), jnp.asarray(dst),
+            [jnp.asarray(m) for m in cm], jnp.asarray(match.any(axis=1)),
+            tile=tile, use_pallas=True, interpret=True)
+        np.testing.assert_array_equal(np.asarray(ob), bins)
+        np.testing.assert_array_equal(np.asarray(orr), row)
+
+
+def test_pair_table_bound_and_coverage(rng):
+    """n_pairs respects the static bound; every output tile is produced."""
+    n, tile = 4096, 256
+    ranges = [(0, 900), (1000, 200), (1200, 64), (1500, 2000)]
+    go_left = rng.rand(n) < 0.5
+    dst, _, cm, match = _dst(go_left, ranges, n)
+    pi, po, copy, npairs = build_pair_tables(
+        jnp.asarray(dst), [jnp.asarray(m) for m in cm],
+        jnp.asarray(match.any(axis=1)), tile)
+    t = n // tile
+    mp = max_pairs_bound(t, len(cm))
+    assert pi.shape == (mp,)
+    assert int(npairs[0]) <= mp
+    # all T output tiles covered, pairs sorted by out tile
+    live = np.asarray(po)[:int(npairs[0])]
+    assert set(live.tolist()) == set(range(t))
+    assert (np.diff(live) >= 0).all()
+    # untouched tiles flagged as raw copies
+    touched = match.any(axis=1).reshape(t, tile).any(axis=1)
+    live_in = np.asarray(pi)[:int(npairs[0])]
+    live_copy = np.asarray(copy)[:int(npairs[0])]
+    for p in range(int(npairs[0])):
+        if live_copy[p]:
+            assert live_in[p] == live[p] and not touched[live_in[p]]
