@@ -38,6 +38,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_TILE_ROWS = 1024  # best of {512, 1024, 2048, 4096} on v5e
 MIN_GROUP_BLOCK = 8  # Mosaic minimum for the second-to-last block dim
@@ -250,4 +251,137 @@ def pallas_histogram_slots(bins: jax.Array, gh: jax.Array, slot: jax.Array,
                                        acc_dtype),
         interpret=interpret,
     )(bins, gh, slot)
+    return out[:G].transpose(0, 2, 1)  # [G, B, SC]
+
+
+def active_tile_table(starts: jax.Array, ends: jax.Array, valid: jax.Array,
+                      n_tiles: int, tile_rows: int):
+    """Row-tile indirection table for the ragged wave histogram.
+
+    starts/ends [K] int32 half-open row ranges (leaf-contiguous layout),
+    valid [K] bool. Returns (tiles [n_tiles] int32, n_active [1] int32):
+    the ascending indices of every tile overlapping a valid range, padded
+    past n_active by repeating the last active tile (same block index =>
+    the kernel pipeline skips the redundant DMA and pl.when skips compute).
+    """
+    t = jnp.arange(n_tiles, dtype=jnp.int32)
+    lo = t * tile_rows
+    act = (((lo[:, None] < ends[None, :])
+            & (lo[:, None] + tile_rows > starts[None, :]))
+           & valid[None, :]).any(axis=1)
+    order = jnp.argsort(~act, stable=True).astype(jnp.int32)  # actives first
+    n_act = act.sum().astype(jnp.int32)
+    last = jnp.take(order, jnp.maximum(n_act - 1, 0))
+    tiles = jnp.where(t < n_act, order, last)
+    return tiles, n_act[None]
+
+
+def _make_slots_ragged_kernel(num_bins: int, tile_rows: int, n_slots: int,
+                              ch: int, compute_dtype, acc_dtype,
+                              group_block: int):
+    SC = n_slots * ch
+    quantized = jnp.issubdtype(jnp.dtype(acc_dtype), jnp.integer)
+
+    def kernel(tiles_ref, nact_ref, bins_ref, gh_ref, slot_ref, out_ref):
+        t = pl.program_id(1)
+
+        @pl.when(t == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        @pl.when(t < nact_ref[0])
+        def _acc():
+            s = slot_ref[...]  # [TN, 1] int32
+            ghc = gh_ref[...]  # [TN, ch] f32 (quantized: exact small ints)
+            col = jax.lax.broadcasted_iota(jnp.int32, (1, SC), 1)
+            colslot, colch = col // ch, col % ch
+            gsum = jnp.zeros((tile_rows, SC), jnp.float32)
+            for c in range(ch):
+                gsum += ghc[:, c:c + 1] * (colch == c).astype(jnp.float32)
+            ghK = (gsum * (colslot == s).astype(jnp.float32)
+                   ).astype(compute_dtype)
+            iota = jax.lax.broadcasted_iota(jnp.int32,
+                                            (tile_rows, num_bins), 1)
+            for gi in range(group_block):
+                b = bins_ref[gi, :]
+                onehot = (b[:, None] == iota).astype(compute_dtype)
+                acc = jax.lax.dot_general(
+                    ghK, onehot,
+                    dimension_numbers=(((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=(jax.lax.Precision.HIGHEST
+                               if compute_dtype == jnp.float32 else
+                               jax.lax.Precision.DEFAULT))  # [SC, B]
+                # quantized: per-tile partial sums are exact ints in f32
+                # (<= tile_rows * 127 * 255 < 2**24); accumulate int32
+                out_ref[gi] += acc.astype(acc_dtype) if quantized else acc
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("num_bins", "n_slots", "tile_rows",
+                                   "quantized", "f32", "interpret"))
+def pallas_histogram_slots_ragged(bins: jax.Array, gh: jax.Array,
+                                  slot: jax.Array, tiles: jax.Array,
+                                  n_active: jax.Array,
+                                  num_bins: int, n_slots: int,
+                                  tile_rows: int = DEFAULT_TILE_ROWS,
+                                  quantized: bool = False,
+                                  f32: bool = False,
+                                  interpret: bool = False) -> jax.Array:
+    """pallas_histogram_slots restricted to an indirected set of row tiles.
+
+    The rows-in-leaf wave histogram: `tiles` (from active_tile_table) names
+    the row tiles overlapping the wave's selected leaf ranges; the grid
+    walks ONLY those via scalar-prefetched index maps (MoE-style ragged
+    blocks), so per-wave cost is O(rows in selected leaves) instead of
+    O(N). Rows inside a listed tile but outside every selected range must
+    carry slot >= n_slots (the dump slot). `n_active` is a traced [1]
+    int32 — inactive tail entries of `tiles` repeat the last active tile
+    and are skipped.
+
+    gh is ALWAYS [N, CH] f32 here (the leaf-contiguous row payload).
+    quantized=True means gh holds small exact ints; the build stays f32,
+    operands go bf16 (exact <= 255), per-tile partials are exact in f32
+    and accumulate int32 — bit-identical to the int8 dense path.
+    """
+    G, N = bins.shape
+    CH = gh.shape[1]
+    SC = n_slots * CH
+    if N % tile_rows:
+        raise ValueError("ragged histogram requires N padded to tile_rows")
+    if quantized:
+        compute_dtype, acc_dtype = jnp.bfloat16, jnp.int32
+    elif f32:
+        compute_dtype, acc_dtype = jnp.float32, jnp.float32
+    else:
+        compute_dtype, acc_dtype = jnp.bfloat16, jnp.float32
+    T = tiles.shape[0]
+    bins = bins.astype(jnp.int32)
+    slot = slot.reshape(N, 1).astype(jnp.int32)
+    GB = _group_block(G, SC, num_bins)
+    g_blocks = max(-(-G // GB), 1)
+    g_pad = g_blocks * GB - G
+    if g_pad:
+        bins = jnp.pad(bins, ((0, g_pad), (0, 0)), constant_values=0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(g_blocks, T),
+        in_specs=[
+            pl.BlockSpec((GB, tile_rows), lambda g, t, tr, na: (g, tr[t])),
+            pl.BlockSpec((tile_rows, CH), lambda g, t, tr, na: (tr[t], 0)),
+            pl.BlockSpec((tile_rows, 1), lambda g, t, tr, na: (tr[t], 0)),
+        ],
+        out_specs=pl.BlockSpec((GB, SC, num_bins),
+                               lambda g, t, tr, na: (g, 0, 0)),
+    )
+    out = pl.pallas_call(
+        _make_slots_ragged_kernel(num_bins, tile_rows, n_slots, CH,
+                                  compute_dtype, acc_dtype, GB),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((g_blocks * GB, SC, num_bins),
+                                       acc_dtype),
+        interpret=interpret,
+    )(tiles.astype(jnp.int32), n_active.astype(jnp.int32),
+      bins, gh.astype(jnp.float32), slot)
     return out[:G].transpose(0, 2, 1)  # [G, B, SC]

@@ -102,6 +102,91 @@ def test_pallas_histogram_slots_quantized_exact(rng):
                                       ref.astype(np.int64))
 
 
+def _ragged_setup(rng, n, tile, ranges, S, quantized=False):
+    """Leaf-contiguous layout: slot < S only inside the given ranges."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.hist_pallas import active_tile_table
+
+    G, B = 3, 16
+    bins = rng.randint(0, B, size=(G, n)).astype(np.int32)
+    if quantized:
+        gh = np.stack([rng.randint(-4, 5, n), rng.randint(0, 6, n),
+                       np.ones(n)], axis=1).astype(np.float32)
+    else:
+        gh = rng.randn(n, 3).astype(np.float32)
+    slot = np.full(n, S, dtype=np.int32)  # dump by default
+    for k, (s, e) in enumerate(ranges):
+        slot[s:e] = k % S
+    starts = jnp.asarray([s for s, _ in ranges], jnp.int32)
+    ends = jnp.asarray([e for _, e in ranges], jnp.int32)
+    tiles, n_act = active_tile_table(starts, ends,
+                                     jnp.ones(len(ranges), bool),
+                                     n // tile, tile)
+    return G, B, bins, gh, slot, tiles, n_act
+
+
+@pytest.mark.parametrize("ranges", [
+    [(0, 700), (1024, 1100), (2000, 3000)],
+    [(512, 1024)],                      # tile-aligned single range
+    [(100, 101), (3500, 4096)],         # tiny + tail
+])
+def test_pallas_histogram_slots_ragged(rng, ranges):
+    from lightgbm_tpu.ops.hist_pallas import pallas_histogram_slots_ragged
+
+    n, tile, S = 4096, 512, 4
+    G, B, bins, gh, slot, tiles, n_act = _ragged_setup(rng, n, tile, ranges,
+                                                       S)
+    ours = np.asarray(pallas_histogram_slots_ragged(
+        jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(slot), tiles, n_act,
+        B, S, tile_rows=tile, f32=True, interpret=True))
+    assert ours.shape == (G, B, S * 3)
+    covered = int(np.asarray(n_act)[0]) * tile
+    assert covered <= n  # ragged grid walks only overlapping tiles
+    for s in range(S):
+        ref = _ref_hist(bins, np.where((slot == s)[:, None], gh, 0.0), B)
+        np.testing.assert_allclose(ours[..., s * 3:(s + 1) * 3], ref,
+                                   rtol=1e-5, atol=1e-4)
+
+
+def test_pallas_histogram_slots_ragged_quantized_exact(rng):
+    """Quantized ragged path: f32 gh holding small ints, bf16 operands,
+    int32 accumulation — must match the dense int8 path bit-for-bit."""
+    from lightgbm_tpu.ops.hist_pallas import (pallas_histogram_slots,
+                                              pallas_histogram_slots_ragged)
+
+    n, tile, S = 4096, 512, 3
+    ranges = [(0, 900), (1500, 2600), (3000, 4000)]
+    G, B, bins, gh, slot, tiles, n_act = _ragged_setup(
+        rng, n, tile, ranges, S, quantized=True)
+    ours = np.asarray(pallas_histogram_slots_ragged(
+        jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(slot), tiles, n_act,
+        B, S, tile_rows=tile, quantized=True, interpret=True))
+    assert ours.dtype == np.int32
+    dense = np.asarray(pallas_histogram_slots(
+        jnp.asarray(bins), jnp.asarray(gh.astype(np.int8)),
+        jnp.asarray(slot), B, S, quantized=True, interpret=True))
+    np.testing.assert_array_equal(ours, dense)
+
+
+def test_active_tile_table():
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.hist_pallas import active_tile_table
+
+    tiles, n_act = active_tile_table(
+        jnp.asarray([0, 1024, 4000], jnp.int32),
+        jnp.asarray([512, 1536, 4096], jnp.int32),
+        jnp.asarray([True, True, False]), 8, 512)
+    # [0,512) -> tile 0; [1024,1536) -> tile 2; third range invalid
+    assert int(n_act[0]) == 2
+    np.testing.assert_array_equal(np.asarray(tiles)[:3], [0, 2, 2])
+    # boundary straddle: [500, 1030) touches tiles 0, 1, 2
+    tiles, n_act = active_tile_table(
+        jnp.asarray([500], jnp.int32), jnp.asarray([1030], jnp.int32),
+        jnp.asarray([True]), 4, 512)
+    assert int(n_act[0]) == 3
+    np.testing.assert_array_equal(np.asarray(tiles), [0, 1, 2, 2])
+
+
 def test_pallas_histogram_quantized_exact(rng):
     G, B, n = 4, 32, 5000
     bins = rng.randint(0, B, size=(G, n)).astype(np.int32)
