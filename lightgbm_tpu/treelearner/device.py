@@ -3,35 +3,45 @@
 The host-driven SerialTreeLearner pays per-split dispatch latency (3 calls +
 2 blocking scalar pulls), which dominates wall-clock on a remote-attached
 TPU. This learner instead grows the ENTIRE tree inside a single jitted
-function: a `lax.while_loop` over speculative WAVES carrying
+function: a `lax.while_loop` over speculative WAVES carrying the data in a
+LEAF-CONTIGUOUS permutation:
 
-    leaf_id    [N]          per-row leaf assignment (bagged-out rows = -1)
+    bins_p     [Gp,Np]      bin columns, rows permuted leaf-contiguously
+    row_p      [Np,CH+2]    f32 payload: gh channels + perm + leaf id
+    start/cnt  [L+1]        per-leaf (start, count) row ranges
+    pool       [L+1,G,B,CH] per-leaf histograms (subtraction trick)
     leaf_best  [L+1,R]      per-leaf packed best-split records
     depth      [L+1]        per-leaf depth
     rec_store  [L,R+4]      the split log the host replays into a Tree
 
-Per wave: top-K frontier leaves by gain -> BOTH children's histograms for
-all K in ONE 2*K*3-channel masked full-N one-hot MXU contraction (Pallas,
-ops/hist_pallas.py) -> 2K split scans -> an on-device replay that commits
-splits in exact best-first order until the argmax needs a leaf whose
-children were not precomputed (see grow_tree_on_device's docstring). All
-shapes are static; the only host traffic per TREE is the split log + final
-leaf ids.
+Per wave: top-K frontier leaves by gain -> stable 2-way partition of every
+selected leaf's range (ops/compact_pallas.py) -> ragged rows-in-leaf
+histogram of ONLY the smaller children (ops/hist_pallas.py ragged tiles,
+K*CH channels) -> larger children by histogram subtraction from the pool ->
+2K split scans -> an on-device replay that commits splits in exact
+best-first order until the argmax needs a leaf whose children were not
+precomputed. All shapes are static; the only host traffic per TREE is the
+split log + final leaf ids (recovered in original row order by one
+sort_key_val over the carried permutation).
 
-Design notes, each measured on hardware:
-  * No histogram pool, no subtraction trick: with full-N masked histograms
-    a child costs the same either way, and a [L+1, G, B, 3] pool carried
-    through the loop defeats XLA's in-place buffer analysis once a Pallas
-    call sits in the body (~10 ms/split of copies).
-  * Row routing (which leaf/slot owns a row, split decision fields, commit
-    application) is all compares and masked [N,K]@[K,F] matmuls — TPU
-    gathers serialize, elementwise compares and matmuls vectorize.
+Design notes:
+  * Histogram work per tree is O(rows in selected leaves) ~ <= ~4N, not
+    O(N * waves): the wave partitions FIRST (safe even for leaves the
+    replay later declines — an internally reordered range is still one
+    contiguous range), then histograms only the smaller-child subranges.
+  * Row routing (which leaf owns a row, split decision fields, commit
+    application) is position-range compares and masked [N,K]@[K,F]
+    matmuls — TPU gathers serialize, compares and matmuls vectorize.
   * The wave replay keeps the reference's leaf-wise semantics bit-exact
     (tree.h best-first; growth stops when the best gain <= 0; masked no-op
     steps write to dump rows so the loop body stays branch-free).
+  * The histogram pool this design needs (subtraction trick) is updated
+    OUTSIDE the replay fori_loop in one vectorized masked write — per-step
+    dynamic pool writes inside the loop defeat XLA's in-place analysis.
 
 Counterpart of SerialTreeLearner::Train + CUDASingleGPUTreeLearner::Train
-(serial_tree_learner.cpp:182, cuda_single_gpu_tree_learner.cpp:169-360).
++ CUDADataPartition::SplitInner (serial_tree_learner.cpp:182,
+cuda_single_gpu_tree_learner.cpp:169-360, cuda_data_partition.cu).
 """
 from __future__ import annotations
 
@@ -108,34 +118,38 @@ def _decide_go_left(gb, thresh, default_left, missing_type, default_bin,
 
 @partial(jax.jit,
          static_argnames=("num_leaves", "num_bins", "max_depth", "quantized",
-                          "batch"))
+                          "batch", "bagged"))
 def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
                         meta, tables: FeatureTables, params: jax.Array,
                         feature_mask: jax.Array,
                         num_leaves: int, num_bins: int, max_depth: int,
                         quantized: bool = False,
                         scale_vec: Optional[jax.Array] = None,
-                        batch: int = 16):
+                        batch: int = 16, bagged: bool = False):
     """Grow one leaf-wise tree fully on device, K splits per histogram pass.
 
     bins [G, N], gh [N, 3] (bagged-out rows must have zero gh),
-    leaf_id0 [N] (0 for in-bag rows, -1 otherwise).
-    quantized: gh is int8 (g_int, h_int, 1); histograms accumulate exact
-    int32 on the MXU and re-enter float space via scale_vec at scan time —
+    leaf_id0 [N] (0 for in-bag rows, -1 otherwise; pass bagged=True when
+    any row is bagged out so the initial compaction runs).
+    quantized: gh is int8 (g_int, h_int, 1); histogram values stay exact
+    ints (int32 pool) and re-enter float space via scale_vec at scan time —
     the on-device twin of the serial learner's quantized path.
 
-    Frontier-batched speculative histograms: each WAVE takes the top-K
-    frontier leaves by gain, computes BOTH children's histograms for all of
-    them in ONE full-N contraction with 2*K*3 gh channels, then an on-device
-    replay commits splits in exact best-first order until the global argmax
-    falls outside the precomputed set (a child created this wave) — then the
-    next wave recomputes. Semantics are EXACTLY the reference's leaf-wise
-    best-first growth (serial_tree_learner.cpp:182): only histogram WORK is
-    speculative, never split decisions. The win: the [TN, B] one-hot — the
-    dominant VPU/VMEM cost of a full-N histogram — is built once per K
-    splits instead of once per split, and K*6 output channels fill the MXU
-    lane dim that a single split's 6 channels leave 95% idle.
-    Returns (rec_store [L-1, STORE], leaf_id [N], num_leaves_final).
+    Rows-in-leaf waves over a leaf-contiguous permutation: each WAVE takes
+    the top-K frontier leaves by gain, PARTITIONS each selected range into
+    left|right in place (stable; safe even if the replay later declines the
+    split — the range stays contiguous), histograms ONLY the smaller-child
+    subranges via ragged tiles (K*CH channels), derives the larger children
+    from the histogram pool by subtraction, then an on-device replay
+    commits splits in exact best-first order until the global argmax falls
+    outside the precomputed set (a child created this wave) — then the next
+    wave recomputes. Semantics are EXACTLY the reference's leaf-wise
+    best-first growth (serial_tree_learner.cpp:182): only histogram and
+    partition WORK is speculative, never split decisions. Histogrammed rows
+    per tree: N (root) + sum over waves of the selected smaller-child rows
+    — <= ~4N in practice vs O(N * waves) for full-N masked waves.
+    Returns (rec_store [L-1, STORE], leaf_id [N] in ORIGINAL row order,
+    num_leaves_final, hist_rows — rows histogrammed, the perf counter).
     """
     L = num_leaves
     G, N = bins.shape
@@ -143,28 +157,46 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
     K = max(1, min(batch, L))
     min_data, min_hess = params[2], params[3]
     neg_inf = jnp.float32(-jnp.inf)
-    gh_dtype = jnp.int8 if quantized else jnp.float32
-    zero_gh = jnp.zeros((), gh_dtype)
-    from ..ops.hist_pallas import DEFAULT_TILE_ROWS, hist_force_f32
+    from ..ops.compact_pallas import (COMPACT_TILE, compact_rows,
+                                      range_partition_dst)
+    from ..ops.hist_pallas import (DEFAULT_TILE_ROWS, active_tile_table,
+                                   hist_force_f32,
+                                   pallas_histogram_slots_ragged)
     from ..ops.histogram import _use_pallas
 
-    # pad rows ONCE to the histogram tile size so the per-wave kernel pads
-    # (a [N, 2K*CH] copy each) vanish; padded rows carry leaf_id -1 and
-    # zero gh, contributing nothing anywhere
-    Np = -(-N // DEFAULT_TILE_ROWS) * DEFAULT_TILE_ROWS
+    # pad rows ONCE to a common multiple of the histogram and compaction
+    # tiles; padded rows carry leaf_id -1 and zero gh and (like bagged-out
+    # rows) sit after every leaf range, contributing nothing anywhere
+    unit = max(DEFAULT_TILE_ROWS, COMPACT_TILE)
+    assert unit % COMPACT_TILE == 0 and unit % DEFAULT_TILE_ROWS == 0
+    Np = -(-N // unit) * unit
     if Np != N:
         bins = jnp.pad(bins, ((0, 0), (0, Np - N)), constant_values=0)
         gh = jnp.pad(gh, ((0, Np - N), (0, 0)))
         leaf_id0 = jnp.pad(leaf_id0, (0, Np - N), constant_values=-1)
-    # in-kernel slot expansion is the default on TPU (the XLA-side [N, 2K*CH]
-    # materialization profiled at ~18 ms/wave); LGBM_TPU_HIST_SLOTS=0 opts out
-    slots_kernel = _use_pallas() and os.environ.get(
+    Gp = -(-G // 8) * 8  # Mosaic: second-to-last block dim multiple of 8
+    bins_p = bins.astype(jnp.int32)
+    if Gp != G:
+        bins_p = jnp.pad(bins_p, ((0, Gp - G), (0, 0)), constant_values=0)
+    T_hist = Np // DEFAULT_TILE_ROWS
+    # Pallas kernels on TPU backends; the XLA fallback (CPU tests) shares
+    # the forward-map/range logic and differs only in kernel dispatch.
+    # LGBM_TPU_PALLAS_INTERPRET=1 runs the TPU kernel path in interpret
+    # mode — CPU-runnable end-to-end coverage of the ragged machinery.
+    interp = os.environ.get("LGBM_TPU_PALLAS_INTERPRET", "").lower() in (
+        "1", "true", "on")
+    use_kernels = (_use_pallas() or interp) and os.environ.get(
         "LGBM_TPU_HIST_SLOTS", "1").lower() not in ("0", "false", "off")
+    pool_dtype = jnp.int32 if quantized else jnp.float32
+    pos = jnp.arange(Np, dtype=jnp.int32)
 
-    def masked_hist(mask):
-        ghm = jnp.where(mask[:, None], gh, zero_gh)
-        return build_histogram(bins, ghm, num_bins,
-                               compute_dtype=gh_dtype)
+    # leaf-contiguous payload: gh channels + original position + leaf id,
+    # all exact in f32 (positions < 2**24, ids < 2**8; quantized int8 gh
+    # values are exact too) and moved bit-exactly by the compaction kernel
+    row_p = jnp.concatenate([
+        gh.astype(jnp.float32), pos.astype(jnp.float32)[:, None],
+        leaf_id0.astype(jnp.float32)[:, None]], axis=1)  # [Np, CH+2]
+    LEAF_COL = CH + 1
 
     def scan_hist(hist):
         if quantized:
@@ -183,9 +215,52 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
             ok &= depth < max_depth
         return rec.at[0].set(jnp.where(ok, rec[0], neg_inf))
 
-    root_mask = leaf_id0 == 0
-    root_hist = masked_hist(root_mask)
+    def ranged_hist(bins_c, row_c, slot, n_slots, starts, ends, valid):
+        """[G, B, n_slots*CH] histogram of the rows inside the given
+        leaf-contiguous ranges (slot must be the dump value outside).
+        bins_c/row_c passed explicitly: inside the wave loop they are the
+        CARRY arrays, not the pre-loop closure values."""
+        if use_kernels:
+            tiles, nact = active_tile_table(starts, ends, valid, T_hist,
+                                            DEFAULT_TILE_ROWS)
+            h = pallas_histogram_slots_ragged(
+                bins_c, row_c[:, :CH], slot, tiles, nact, num_bins,
+                n_slots, quantized=quantized, f32=hist_force_f32(),
+                interpret=interp)
+            return h[:G]
+        # XLA fallback: flat slot-expanded build over the full row set
+        col_slot = jnp.arange(n_slots * CH) // CH
+        ghK = jnp.where(slot[:, None] == col_slot[None, :],
+                        jnp.tile(row_c[:, :CH], (1, n_slots)), 0.0)
+        h = build_histogram(bins_c[:G], ghK, num_bins)
+        return h.astype(pool_dtype)  # quantized: exact ints below 2**24
+
+    # --- initial compaction: in-bag rows to the front, root = [0, n_in)
+    if bagged:
+        in_bag = leaf_id0 == 0
+        n_in = in_bag.sum().astype(jnp.int32)
+        dst0, _ = range_partition_dst(
+            in_bag, jnp.ones((Np, 1), bool), jnp.zeros(1, jnp.int32),
+            jnp.full(1, Np, jnp.int32), jnp.ones(1, bool))
+        bins_p, row_p = compact_rows(
+            bins_p, row_p, dst0, [in_bag, ~in_bag],
+            jnp.ones(Np, bool), tile=COMPACT_TILE,
+            use_pallas=use_kernels, interpret=interp)
+    else:
+        n_in = jnp.int32(N)
+
+    start = jnp.zeros(L + 1, jnp.int32)
+    count = jnp.zeros(L + 1, jnp.int32).at[0].set(n_in)
+
+    # --- root histogram through the ragged slots kernel (satellite: the
+    # thin-CH masked dot cost ~183 ms/tree; this path is O(n_in) and warm)
+    root_hist = ranged_hist(
+        bins_p, row_p, jnp.where(pos < n_in, 0, 1), 1,
+        jnp.zeros(1, jnp.int32), n_in[None], jnp.ones(1, bool))
     root_tot = hist_totals(root_hist)
+    pool = jnp.zeros((L + 1, G, num_bins, CH), pool_dtype).at[0].set(
+        root_hist)
+    hist_rows = n_in  # instrumentation: rows histogrammed this tree
 
     depth = jnp.zeros(L + 1, jnp.int32)
     leaf_best = jnp.full((L + 1, REC), neg_inf, jnp.float32)
@@ -199,7 +274,8 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
     l1, l2, max_delta = params[0], params[1], params[5]
 
     def wave(carry):
-        leaf_id, depth, leaf_best, rec_store, n_cur, t = carry
+        (bins_p, row_p, start, count, depth, leaf_best, rec_store, pool,
+         n_cur, t, hist_rows) = carry
         gains = leaf_best[:L, 0]
         sel_gain, sel = jax.lax.top_k(gains, K)  # [K] distinct leaves
         sel = sel.astype(jnp.int32)
@@ -210,23 +286,26 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         f_k = jnp.maximum(recs_sel[:, 1].astype(jnp.int32), 0)
         thresh_k = recs_sel[:, 2].astype(jnp.int32)
         defl_k = recs_sel[:, 3] > 0.5
+        s_k = jnp.take(start, sel)
+        c_k = jnp.take(count, sel)
+        e_k = s_k + c_k
 
-        # --- per-row wave slot: which selected leaf (if any) owns this row.
+        # --- per-row ownership by POSITION RANGE (leaf-contiguous layout).
         # The [N, K] compare stays VECTORIZED on the VPU; a [L+1]-table
         # gather formulation measured ~20% slower end to end (TPU gathers
         # serialize, elementwise compares do not).
-        match = (leaf_id[:, None] == sel[None, :]) & sel_ok[None, :]  # [N, K]
+        match = ((pos[:, None] >= s_k[None, :])
+                 & (pos[:, None] < e_k[None, :]) & sel_ok[None, :])  # [N, K]
         kvalid = match.any(axis=1)
-        kidx = jnp.argmax(match, axis=1).astype(jnp.int32)  # [N], junk if !kvalid
 
-        # per-row split fields as ONE masked [N,K]@[K,9] matmul over the
+        # per-row split fields as ONE masked [N,K]@[K,F] matmul over the
         # match matrix — vectorized VPU/MXU work; jnp.take gathers here
         # measured far slower (TPU gathers serialize), and separate
-        # per-field matvecs would re-read the [N, K] matrix from HBM nine
+        # per-field matvecs would re-read the [N, K] matrix from HBM many
         # times. Field values are small ints, exact in f32. HIGHEST
         # precision: default TPU matmul rounds operands to bf16 (8 mantissa
         # bits), which would corrupt integer fields > 256 — group ids, new
-        # leaf ids, bin offsets.
+        # leaf ids, bin offsets, row positions.
         matchf = match.astype(jnp.float32)
 
         def rows_of(per_k_fields):  # [K, F] -> [N, F]
@@ -245,35 +324,43 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         # bins[grp_row[n], n] without a gather: compare-select over the G
         # group rows (G*N elementwise beats an N-sized row-varying gather)
         gb_row = jnp.sum(
-            jnp.where(jnp.arange(G)[:, None] == grp_row[None, :], bins, 0),
-            axis=0, dtype=jnp.int32)
+            jnp.where(jnp.arange(Gp)[:, None] == grp_row[None, :], bins_p,
+                      0), axis=0, dtype=jnp.int32)
         go_left = _decide_go_left(
             gb_row, ri[:, 1], rowsF[:, 2] > 0.5, ri[:, 3], ri[:, 4],
             ri[:, 5], ri[:, 6], ri[:, 7], rowsF[:, 8] > 0.5)
 
-        # --- one histogram pass: channel block 2k+0 = left of sel[k],
-        #     2k+1 = right; rows outside the selection hit the dump slot
-        slot2 = jnp.where(kvalid, kidx * 2 + (1 - go_left.astype(jnp.int32)),
-                          2 * K)  # [N] in [0, 2K]
-        if slots_kernel:
-            # in-kernel slot expansion: no [N, 2K*CH] HBM matrix (the XLA
-            # materialization profiled at ~18 ms/wave at 1M rows)
-            from ..ops.hist_pallas import pallas_histogram_slots
+        # --- stable partition of EVERY selected range (speculative: an
+        # uncommitted leaf's range is merely reordered, still contiguous)
+        dst, nl_k = range_partition_dst(go_left, match, s_k, c_k, sel_ok)
+        cmasks = ([match[:, k] & go_left for k in range(K)]
+                  + [match[:, k] & ~go_left for k in range(K)])
+        bins_p, row_p = compact_rows(
+            bins_p, row_p, dst, cmasks, kvalid, tile=COMPACT_TILE,
+            use_pallas=use_kernels, interpret=interp)
 
-            histK = pallas_histogram_slots(
-                bins.astype(jnp.int32), gh, slot2, num_bins, 2 * K,
-                quantized=quantized, f32=hist_force_f32())
-        else:
-            # flat 2D build: column c belongs to slot c//CH, channel c%CH
-            # (profiled: the 3D broadcast+reshape fused badly, and a bf16
-            # output made the fusion 2x SLOWER — keep operand dtype)
-            col_slot = jnp.arange(2 * K * CH) // CH  # [2K*CH]
-            ghK = jnp.where(slot2[:, None] == col_slot[None, :],
-                            jnp.tile(gh, (1, 2 * K)), zero_gh)
-            histK = build_histogram(bins, ghK, num_bins,
-                                    compute_dtype=gh_dtype)  # [G, B, 2K*CH]
-        hists = histK.reshape(G, num_bins, 2 * K, CH)
-        hists = jnp.moveaxis(hists, 2, 0)  # [2K, G, B, CH]
+        # --- ragged histogram of ONLY the smaller children; tie -> left,
+        # matching the serial learner's _apply_split choice
+        nr_k = c_k - nl_k
+        left_small = nl_k <= nr_k
+        ss_k = jnp.where(left_small, s_k, s_k + nl_k)
+        sc_k = jnp.minimum(nl_k, nr_k)
+        se_k = ss_k + sc_k
+        inS = ((pos[:, None] >= ss_k[None, :])
+               & (pos[:, None] < se_k[None, :]) & sel_ok[None, :])
+        slotS = jnp.where(inS.any(axis=1),
+                          jnp.argmax(inS, axis=1).astype(jnp.int32), K)
+        hist_rows = hist_rows + jnp.sum(jnp.where(sel_ok, sc_k, 0))
+        histS = ranged_hist(bins_p, row_p, slotS, K, ss_k, se_k,
+                            sel_ok & (sc_k > 0))
+        histS_k = jnp.moveaxis(
+            histS.reshape(G, num_bins, K, CH), 2, 0)  # [K, G, B, CH]
+        pool_sel = jnp.take(pool, sel, axis=0)  # [K, G, B, CH]
+        histL = jnp.where(left_small[:, None, None, None], histS_k,
+                          pool_sel - histS_k)
+        histR = pool_sel - histL  # subtract_histogram, vectorized
+        hists = jnp.stack([histL, histR], axis=1).reshape(
+            2 * K, G, num_bins, CH)
         totals = hists[:, 0].sum(axis=1)  # [2K, B, CH] bins-summed -> [2K, CH]
         if quantized:
             totals = totals.astype(jnp.float32) * scale_vec[None, :]
@@ -335,26 +422,46 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         (leaf_best, depth, rec_store, n_cur, t, committed, newids,
          _) = jax.lax.fori_loop(0, K, replay_step, rp0)
 
-        # --- apply all committed partitions in one vectorized pass
-        # (one stacked masked matmul again, not [K]-table gathers)
-        post = jnp.stack([committed[:K].astype(jnp.int32), newids[:K]],
-                         axis=1)  # [K, 2]
-        rowsP = rows_of(post)  # [N, 2]
+        # --- commit side effects, all OUTSIDE the replay fori_loop (the
+        # heavy [K, G, B, CH] pool writes and [N]-row updates run once per
+        # wave, vectorized over the committed mask, not once per replay
+        # step). Uncommitted leaves keep their old (start, count, pool)
+        # entries — their ranges were only reordered internally.
+        wbK = jnp.where(committed[:K], sel, L)       # parent keeps left
+        wnK = jnp.where(committed[:K], newids[:K], L)  # new leaf = right
+        pool = pool.at[wbK].set(histL).at[wnK].set(histR)
+        mid_k = s_k + nl_k
+        start = start.at[wnK].set(mid_k)
+        count = count.at[wnK].set(nr_k).at[wbK].set(nl_k)
+
+        # per-row leaf relabel via the same stacked masked matmul (position
+        # >= split midpoint <=> right child, thanks to the partition)
+        post = jnp.stack([committed[:K].astype(jnp.int32), newids[:K],
+                          mid_k], axis=1)  # [K, 3]
+        rowsP = rows_of(post)  # [N, 3]
         com_row = kvalid & (rowsP[:, 0] > 0.5)
-        rid_row = rowsP[:, 1].astype(jnp.int32)
-        leaf_id = jnp.where(com_row & ~go_left, rid_row, leaf_id)
-        return leaf_id, depth, leaf_best, rec_store, n_cur, t
+        is_right = com_row & (pos >= rowsP[:, 2].astype(jnp.int32))
+        leafcol = jnp.where(is_right, rowsP[:, 1], row_p[:, LEAF_COL])
+        row_p = row_p.at[:, LEAF_COL].set(leafcol)
+        return (bins_p, row_p, start, count, depth, leaf_best, rec_store,
+                pool, n_cur, t, hist_rows)
 
     def cond(carry):
-        _, _, leaf_best, _, _, t = carry
+        leaf_best, t = carry[5], carry[9]
         return (t < L - 1) & (jnp.max(leaf_best[:L, 0]) > 0)
 
-    carry = (leaf_id0, depth, leaf_best, rec_store, jnp.int32(1),
-             jnp.int32(0))
+    carry = (bins_p, row_p, start, count, depth, leaf_best, rec_store, pool,
+             jnp.int32(1), jnp.int32(0), hist_rows)
     if L > 1:
         carry = jax.lax.while_loop(cond, wave, carry)
-    leaf_id, _, _, rec_store, n_cur, _ = carry
-    return rec_store[:-1], leaf_id[:N], n_cur
+    row_p, rec_store, n_cur, hist_rows = carry[1], carry[6], carry[8], \
+        carry[10]
+    # undo the permutation without a TPU scatter: sort leaf ids by the
+    # original-position column (both exact small ints in f32)
+    _, leaf_sorted = jax.lax.sort_key_val(
+        row_p[:, CH].astype(jnp.int32),
+        row_p[:, LEAF_COL].astype(jnp.int32))
+    return rec_store[:-1], leaf_sorted[:N], n_cur, hist_rows
 
 
 class DevicePartition:
@@ -417,12 +524,15 @@ class DeviceTreeLearner(SerialTreeLearner):
         else:
             fmask = jnp.ones(len(self.meta.real_feature), dtype=bool)
         with global_timer.scope("tree_device"):
-            rec_store, leaf_id, _ = grow_tree_on_device(
+            rec_store, leaf_id, _, hist_rows = grow_tree_on_device(
                 self.bins_dev, gh, leaf_id0, self.meta, self.tables,
                 self.params_dev, fmask, num_leaves, self.group_bin_padded,
                 cfg.max_depth, quantized=self.quantized,
-                scale_vec=self._scale_vec, batch=self.wave)
+                scale_vec=self._scale_vec, batch=self.wave,
+                bagged=bag_indices is not None)
             rec_np = np.asarray(rec_store)  # the one transfer per tree
+        self.last_hist_rows = int(hist_rows)
+        global_timer.add_count("device_hist_rows", self.last_hist_rows)
 
         counts: Dict[int, int] = {0: int(self.num_data if bag_indices is None
                                          else len(bag_indices))}

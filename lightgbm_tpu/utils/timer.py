@@ -19,6 +19,7 @@ class GlobalTimer:
     def __init__(self) -> None:
         self.totals: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
+        self.counters: Dict[str, int] = defaultdict(int)
         self.enabled = bool(os.environ.get("LGBM_TPU_TIMETAG"))
 
     @contextlib.contextmanager
@@ -38,15 +39,27 @@ class GlobalTimer:
         self.totals[label] += time.perf_counter() - start
         self.counts[label] += 1
 
+    def add_count(self, label: str, n: int) -> None:
+        """Accumulate a work counter (rows histogrammed, bytes moved, ...).
+
+        Always on, unlike the wall-clock scopes: counters are cheap ints
+        and the perf tests assert on them (e.g. `device_hist_rows` proving
+        the rows-in-leaf wave path is O(selected rows), not O(N * waves)).
+        """
+        self.counters[label] += int(n)
+
     def report(self) -> str:
         lines = ["LightGBM-TPU timer summary:"]
         for label in sorted(self.totals, key=self.totals.get, reverse=True):
             lines.append(f"  {label}: {self.totals[label]:.3f}s ({self.counts[label]} calls)")
+        for label in sorted(self.counters):
+            lines.append(f"  {label}: {self.counters[label]}")
         return "\n".join(lines)
 
     def reset(self) -> None:
         self.totals.clear()
         self.counts.clear()
+        self.counters.clear()
 
 
 global_timer = GlobalTimer()

@@ -90,6 +90,57 @@ def test_device_stops_on_no_gain(rng):
     assert stop or stop2
 
 
+def test_device_hist_rows_counter(rng):
+    """Rows histogrammed per tree must be O(rows in selected leaves):
+    root N + sum of smaller-child rows <= ~2N for a full leaf-wise tree,
+    NOT O(N * waves). Narrow waves force many waves so the old full-N
+    formulation would blow far past the bound."""
+    from lightgbm_tpu.utils.timer import global_timer
+
+    n = 2000
+    X = rng.randn(n, 8)
+    y = 2 * X[:, 0] - X[:, 1] + np.sin(3 * X[:, 2]) + 0.1 * rng.randn(n)
+    cfg = Config({"objective": "regression", "num_leaves": 31,
+                  "min_data_in_leaf": 5, "verbosity": -1})
+    ds = CoreDataset.from_matrix(X, label=y, config=cfg)
+    obj = create_objective("regression", cfg)
+    bst = GBDT(cfg, ds, obj)
+    learner = DeviceTreeLearner(cfg, ds)
+    learner.wave = 4  # many waves: the O(N * waves) failure mode is loud
+    bst.tree_learner = learner
+    global_timer.counters.pop("device_hist_rows", None)
+    bst.train_one_iter()
+    assert learner.last_hist_rows > 0
+    # root pass = N rows; each of the <=30 splits histograms the SMALLER
+    # child (<= half its parent), summing to <= N per depth level of work;
+    # 4N is a generous ceiling that O(N*waves) (>= 8N here) cannot meet
+    assert learner.last_hist_rows <= 4 * n, learner.last_hist_rows
+    assert global_timer.counters["device_hist_rows"] == learner.last_hist_rows
+    assert "device_hist_rows" in global_timer.report()
+
+
+def test_device_pallas_interpret_matches_serial(rng, monkeypatch):
+    """End-to-end coverage of the Pallas ragged-histogram + compaction wave
+    path on CPU via interpret mode (on TPU this is the production path)."""
+    monkeypatch.setenv("LGBM_TPU_PALLAS_INTERPRET", "1")
+    # f32 operands: parity with the serial learner to float tolerance (the
+    # TPU-default bf16 operands round gh to 8 mantissa bits by design)
+    monkeypatch.setenv("LGBM_TPU_HIST_F32", "1")
+    from lightgbm_tpu.treelearner import device as device_mod
+
+    device_mod.grow_tree_on_device.clear_cache()
+    try:
+        X = rng.randn(1200, 6)
+        y = (X[:, 0] - 0.6 * X[:, 1] + rng.randn(1200) * 0.3 > 0).astype(float)
+        params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+        serial, device = _boosters(X, y, params, n_iters=2)
+        np.testing.assert_allclose(serial.predict(X, raw_score=True),
+                                   device.predict(X, raw_score=True),
+                                   rtol=1e-4, atol=1e-5)
+    finally:
+        device_mod.grow_tree_on_device.clear_cache()
+
+
 def test_device_learner_quantized_matches_serial_quantized(rng):
     """Quantized int8/int32 path in the fori_loop learner: identical int
     gradients (same PRNG seed + call order) must reproduce the serial
