@@ -295,6 +295,21 @@ class Config:
         # set num_leaves explicitly (config.cpp CheckParamConflict)
         if self.max_depth > 0 and "num_leaves" not in self.raw_params:
             self.num_leaves = min(self.num_leaves, (1 << self.max_depth))
+        # accepted-but-unimplemented gain modifiers: warn LOUDLY at config
+        # time rather than silently training a different model than the
+        # reference would (config.h:554 path_smooth, config.h:600
+        # monotone_penalty feed SplitInfo gains there; the split scan here
+        # does not read them yet)
+        if self.path_smooth > 0:
+            Log.warning(
+                "path_smooth=%g is NOT implemented by this learner and is "
+                "IGNORED; the trained model will differ from the reference. "
+                "Set path_smooth=0 to silence.", self.path_smooth)
+        if self.monotone_penalty > 0:
+            Log.warning(
+                "monotone_penalty=%g is NOT implemented by this learner and "
+                "is IGNORED (monotone_constraints themselves ARE enforced); "
+                "set monotone_penalty=0 to silence.", self.monotone_penalty)
         # linear-tree constraints (config.cpp:425-440)
         if self.linear_tree:
             if self.tree_learner != "serial":

@@ -1,0 +1,36 @@
+"""bench.py smoke test: the benchmark entrypoint must emit its ONE JSON
+record with a real throughput number on a small CPU run — catching drift
+between the bench harness and the library surface before a capture round
+burns a TPU window on it."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_smoke_cpu():
+    env = dict(os.environ)
+    env.update({
+        "BENCH_ROWS": "20000",
+        "BENCH_ITERS": "2",
+        "BENCH_PLATFORM": "cpu",  # skip the accelerator probe entirely
+        "BENCH_QUANTIZED": "0",   # primary metric only: keep the smoke fast
+        "JAX_PLATFORMS": "cpu",
+    })
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=_REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    # last stdout line is the structured record
+    record = json.loads(out.stdout.strip().splitlines()[-1])
+    assert record["metric"] == "train_row_iters_per_sec"
+    assert record["platform"] == "cpu"
+    assert "error" not in record, record
+    assert record["value"] > 0
+    assert record["rows"] == 20000
+    assert 0.5 <= record["auc"] <= 1.0

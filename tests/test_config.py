@@ -113,3 +113,15 @@ def test_verbosity_duplicate_takes_min():
     assert kv2map(["verbosity=1", "verbosity=-1"]) == {"verbosity": "-1"}
     out = key_alias_transform({"verbosity": 1, "verbose": -1})
     assert out == {"verbosity": -1}
+
+
+def test_unimplemented_gain_params_warn_loudly(capsys):
+    """path_smooth / monotone_penalty must never be silent no-ops: the
+    config emits a loud warning naming the ignored parameter."""
+    Config({"path_smooth": 0.5, "monotone_penalty": 2.0})
+    out = capsys.readouterr().out
+    assert "path_smooth" in out and "IGNORED" in out
+    assert "monotone_penalty" in out
+    # defaults stay quiet
+    Config()
+    assert "path_smooth" not in capsys.readouterr().out
