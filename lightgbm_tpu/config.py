@@ -310,6 +310,37 @@ class Config:
                 "monotone_penalty=%g is NOT implemented by this learner and "
                 "is IGNORED (monotone_constraints themselves ARE enforced); "
                 "set monotone_penalty=0 to silence.", self.monotone_penalty)
+        # same contract for the rest of the accepted-but-unimplemented
+        # model-altering params (graftlint R4 enforces that every spec
+        # entry is either read by a subsystem or acknowledged here)
+        if self.extra_trees:
+            Log.warning(
+                "extra_trees=true (and extra_seed=%d) is NOT implemented: "
+                "thresholds are always scanned exhaustively, so the trained "
+                "model will differ from the reference.", self.extra_seed)
+        if self.feature_contri:
+            Log.warning(
+                "feature_contri is NOT implemented and is IGNORED; per-"
+                "feature gain scaling will not be applied.")
+        if self.early_stopping_min_delta > 0:
+            Log.warning(
+                "early_stopping_min_delta=%g is NOT implemented; early "
+                "stopping compares scores without a minimum improvement "
+                "threshold.", self.early_stopping_min_delta)
+        if self.bagging_by_query:
+            Log.warning(
+                "bagging_by_query=true is NOT implemented; bagging always "
+                "samples individual rows, not whole queries.")
+        if self.weight_column or self.group_column or self.ignore_column:
+            Log.warning(
+                "weight_column/group_column/ignore_column are text-parser "
+                "directives and are IGNORED by the array-input pipeline; "
+                "pass weights/groups to fit() and drop columns before "
+                "construction instead.")
+        if self.deterministic:
+            Log.info(
+                "deterministic=true needs no special handling here: XLA "
+                "reductions are deterministic for a fixed device topology.")
         # linear-tree constraints (config.cpp:425-440)
         if self.linear_tree:
             if self.tree_learner != "serial":

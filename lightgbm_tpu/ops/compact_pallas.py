@@ -156,7 +156,7 @@ def build_pair_tables(dst: jax.Array, class_masks: Sequence[jax.Array],
                                              jnp.int32)])
     key = key[:mp]
     last = jnp.take(key, jnp.maximum(n_pairs - 1, 0))
-    key = jnp.where(jnp.arange(mp) < n_pairs, key, last)
+    key = jnp.where(jnp.arange(mp, dtype=jnp.int32) < n_pairs, key, last)
     pair_in = key % T
     pair_out = key // T
     # untouched tiles: identity pair does a raw block copy, no matmul.

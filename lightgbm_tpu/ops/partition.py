@@ -108,12 +108,12 @@ def partition_rows_cat(bins_row: jax.Array, row_idx: jax.Array,
                        ) -> Tuple[jax.Array, jax.Array]:
     """partition_rows with a categorical bin-set decision."""
     P = row_idx.shape[0]
-    valid = jnp.arange(P) < count
+    valid = jnp.arange(P, dtype=jnp.int32) < count
     gb = jnp.take(bins_row, jnp.minimum(row_idx, n_data - 1))
     go_left = split_decision_bins_cat(gb, decision, cat_mask) & valid
     key = jnp.where(go_left, 0, jnp.where(valid, 1, 2)).astype(jnp.int32)
     order = jnp.argsort(key, stable=True)
-    sorted_idx = jnp.where(jnp.arange(P) < count, row_idx[order], n_data)
+    sorted_idx = jnp.where(jnp.arange(P, dtype=jnp.int32) < count, row_idx[order], n_data)
     return sorted_idx, go_left.sum()
 
 
@@ -130,12 +130,12 @@ def partition_rows(bins_row: jax.Array, row_idx: jax.Array, count: jax.Array,
     padding — and left_count).
     """
     P = row_idx.shape[0]
-    valid = jnp.arange(P) < count
+    valid = jnp.arange(P, dtype=jnp.int32) < count
     gb = jnp.take(bins_row, jnp.minimum(row_idx, n_data - 1))
     go_left = split_decision_bins(gb, decision) & valid
     key = jnp.where(go_left, 0, jnp.where(valid, 1, 2)).astype(jnp.int32)
     order = jnp.argsort(key, stable=True)
-    sorted_idx = jnp.where(jnp.arange(P) < count, row_idx[order], n_data)
+    sorted_idx = jnp.where(jnp.arange(P, dtype=jnp.int32) < count, row_idx[order], n_data)
     return sorted_idx, go_left.sum()
 
 
@@ -152,7 +152,8 @@ class RowPartition:
         self.num_data = num_data
         self.min_bucket = min_bucket
         root = np.arange(num_data, dtype=np.int32)
-        self.leaf_idx = {0: jnp.asarray(pad_indices(root, num_data, min_bucket))}
+        self.leaf_idx = {0: jnp.asarray(pad_indices(root, num_data, min_bucket),
+                                        dtype=jnp.int32)}
         self.leaf_count = {0: num_data}
 
     def indices(self, leaf: int) -> jax.Array:
@@ -182,14 +183,16 @@ class RowPartition:
         lp = bucket_size(left_cnt, self.min_bucket)
         rp = bucket_size(right_cnt, self.min_bucket)
         left_idx = sorted_idx[:lp]
-        left_idx = jnp.where(jnp.arange(lp) < left_cnt, left_idx, self.num_data)
+        left_idx = jnp.where(jnp.arange(lp, dtype=jnp.int32) < left_cnt, left_idx,
+                             self.num_data)
         # pad before slicing: dynamic_slice clamps its start index when
         # start+size exceeds the array, which would silently hand left rows
         # to the right child
         padded = jnp.concatenate([
             sorted_idx, jnp.full(rp, self.num_data, sorted_idx.dtype)])
         right_idx = jax.lax.dynamic_slice(padded, (left_cnt,), (rp,))
-        right_idx = jnp.where(jnp.arange(rp) < right_cnt, right_idx, self.num_data)
+        right_idx = jnp.where(jnp.arange(rp, dtype=jnp.int32) < right_cnt, right_idx,
+                              self.num_data)
         self.leaf_idx[leaf] = left_idx
         self.leaf_count[leaf] = left_cnt
         self.leaf_idx[new_leaf] = right_idx
@@ -199,5 +202,6 @@ class RowPartition:
     def set_used_indices(self, indices: np.ndarray) -> None:
         """Restrict the root to a bagging subset (SetUsedDataIndices)."""
         self.leaf_idx = {0: jnp.asarray(pad_indices(indices.astype(np.int32),
-                                                    self.num_data, self.min_bucket))}
+                                                    self.num_data, self.min_bucket),
+                                        dtype=jnp.int32)}
         self.leaf_count = {0: len(indices)}

@@ -157,21 +157,22 @@ def pack_ensemble(trees: Sequence[Tree], dtype=jnp.float32,
         th32[over] = np.nextafter(th32[over], -np.inf)
         th = th32
     return PackedEnsemble(
-        split_feature=jnp.asarray(sf),
+        split_feature=jnp.asarray(sf, dtype=jnp.int32),
         threshold=jnp.asarray(th, dtype=jnp.float64 if f64_effective else jnp.float32),
-        decision_type=jnp.asarray(dt),
-        left_child=jnp.asarray(lc),
-        right_child=jnp.asarray(rc),
+        decision_type=jnp.asarray(dt, dtype=jnp.int32),
+        left_child=jnp.asarray(lc, dtype=jnp.int32),
+        right_child=jnp.asarray(rc, dtype=jnp.int32),
         leaf_value=jnp.asarray(lv, dtype=dtype),
-        cat_words=jnp.asarray(np.array(cat_words, dtype=np.uint32)),
-        cat_offset=jnp.asarray(co),
-        cat_n_words=jnp.asarray(cw_n),
-        num_leaves=jnp.asarray(nl),
+        cat_words=jnp.asarray(np.array(cat_words, dtype=np.uint32),
+                              dtype=jnp.uint32),
+        cat_offset=jnp.asarray(co, dtype=jnp.int32),
+        cat_n_words=jnp.asarray(cw_n, dtype=jnp.int32),
+        num_leaves=jnp.asarray(nl, dtype=jnp.int32),
         max_depth=max(int(max_depth), fixed_depth),
         num_trees=len(trees),
         linear=any_linear,
         lin_const=jnp.asarray(lin_const, dtype=dtype) if any_linear else None,
-        lin_feat=jnp.asarray(lin_feat) if any_linear else None,
+        lin_feat=jnp.asarray(lin_feat, dtype=jnp.int32) if any_linear else None,
         lin_coeff=jnp.asarray(lin_coeff, dtype=dtype) if any_linear else None,
     )
 
@@ -224,7 +225,7 @@ def predict_leaf_indices(packed: PackedEnsemble, X: jax.Array) -> jax.Array:
     """[N, T] leaf index per row per tree."""
     T = packed.num_trees
     leaf_fn = jax.vmap(lambda k: _tree_leaf_index(packed, k, X, packed.max_depth))
-    return leaf_fn(jnp.arange(T)).T
+    return leaf_fn(jnp.arange(T, dtype=jnp.int32)).T
 
 
 def predict_raw(packed: PackedEnsemble, X: jax.Array, num_tree_per_iteration: int = 1) -> jax.Array:
@@ -251,7 +252,7 @@ def predict_raw(packed: PackedEnsemble, X: jax.Array, num_tree_per_iteration: in
             used, packed.lin_coeff[k][leaf] * fv, 0.0).sum(axis=1)
         return jnp.where(bad, base, lin)
 
-    scores = jax.vmap(tree_score)(jnp.arange(T))  # [T, N]
+    scores = jax.vmap(tree_score)(jnp.arange(T, dtype=jnp.int32))  # [T, N]
     scores = scores.reshape(T // num_tree_per_iteration, num_tree_per_iteration, X.shape[0])
     return scores.sum(axis=0).T  # [N, C]
 
@@ -284,7 +285,8 @@ def predict_raw_early_stop(packed: PackedEnsemble, X: jax.Array,
         pad = bucket_size(idx.size, 256)
         idx_pad = np.zeros(pad, dtype=np.int64)
         idx_pad[: idx.size] = idx
-        Xa = jnp.asarray(X)[jnp.asarray(idx_pad)]
+        # graftlint: disable=implicit-dtype -- X keeps its caller dtype (f32 or f64)
+        Xa = jnp.asarray(X)[jnp.asarray(idx_pad, dtype=jnp.int32)]
         sl = packed.tree_slice(start, min(start + block, T))
         delta = np.asarray(predict_raw(sl, Xa, C))[: idx.size]
         out[idx] += delta

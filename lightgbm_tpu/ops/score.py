@@ -64,12 +64,17 @@ def binned_tree_arrays(tree, dataset) -> BinnedTreeArrays:
         nb[n] = mapper.num_bin
         lo[n], hi[n], ie[n] = l, h, fg.is_multi
     return BinnedTreeArrays(
-        group=jnp.asarray(gi), threshold=jnp.asarray(th),
-        default_left=jnp.asarray(dl), missing_type=jnp.asarray(mt),
-        default_bin=jnp.asarray(db), nbins=jnp.asarray(nb),
-        efb_lo=jnp.asarray(lo), efb_hi=jnp.asarray(hi), is_efb=jnp.asarray(ie),
-        left_child=jnp.asarray(tree.left_child[:ni].astype(np.int32)),
-        right_child=jnp.asarray(tree.right_child[:ni].astype(np.int32)),
+        group=jnp.asarray(gi, dtype=jnp.int32),
+        threshold=jnp.asarray(th, dtype=jnp.int32),
+        default_left=jnp.asarray(dl, dtype=jnp.bool_),
+        missing_type=jnp.asarray(mt, dtype=jnp.int32),
+        default_bin=jnp.asarray(db, dtype=jnp.int32),
+        nbins=jnp.asarray(nb, dtype=jnp.int32),
+        efb_lo=jnp.asarray(lo, dtype=jnp.int32),
+        efb_hi=jnp.asarray(hi, dtype=jnp.int32),
+        is_efb=jnp.asarray(ie, dtype=jnp.bool_),
+        left_child=jnp.asarray(tree.left_child[:ni], dtype=jnp.int32),
+        right_child=jnp.asarray(tree.right_child[:ni], dtype=jnp.int32),
         leaf_value=jnp.asarray(tree.leaf_value[: tree.num_leaves],
                                dtype=jnp.float32),
     )

@@ -123,14 +123,14 @@ def make_feature_meta(dataset, group_bin_padded: int) -> FeatureMeta:
                 gather[k, b] = gi * group_bin_padded + slot
             efb_omitted[k] = True
     return FeatureMeta(
-        gather_index=jnp.asarray(gather),
-        valid_slot=jnp.asarray(valid),
-        default_bin=jnp.asarray(default_bin),
-        efb_omitted=jnp.asarray(efb_omitted),
-        missing_type=jnp.asarray(missing),
-        nbins=jnp.asarray(nbins),
-        is_categorical=jnp.asarray(is_cat),
-        monotone=jnp.asarray(mono),
+        gather_index=jnp.asarray(gather, dtype=jnp.int32),
+        valid_slot=jnp.asarray(valid, dtype=jnp.bool_),
+        default_bin=jnp.asarray(default_bin, dtype=jnp.int32),
+        efb_omitted=jnp.asarray(efb_omitted, dtype=jnp.bool_),
+        missing_type=jnp.asarray(missing, dtype=jnp.int32),
+        nbins=jnp.asarray(nbins, dtype=jnp.int32),
+        is_categorical=jnp.asarray(is_cat, dtype=jnp.bool_),
+        monotone=jnp.asarray(mono, dtype=jnp.int32),
         real_feature=list(feats),
         max_bins=Bmax,
         hist_rows=G * group_bin_padded,
@@ -265,7 +265,7 @@ def gather_feature_hist(hist: jax.Array, meta: FeatureMeta,
     # histograms flow through here as exact int32)
     missing_mass = totals[None, :].astype(fh.dtype) - fh.sum(axis=1)  # [F, 3]
     add = missing_mass * meta.efb_omitted[:, None]
-    fh = fh.at[jnp.arange(fh.shape[0]), meta.default_bin].add(add)
+    fh = fh.at[jnp.arange(fh.shape[0], dtype=jnp.int32), meta.default_bin].add(add)
     return fh
 
 
@@ -305,11 +305,11 @@ def per_feature_best(fh: jax.Array, totals: jax.Array, meta: FeatureMeta,
     missing_pos = jnp.where(meta.missing_type == MISSING_NAN,
                             meta.nbins - 1, meta.default_bin)
     has_missing = meta.missing_type != MISSING_NONE
-    rows = jnp.arange(F)
+    rows = jnp.arange(F, dtype=jnp.int32)
     missing_vals = jnp.where(has_missing[:, None],
                              fh[rows, missing_pos], 0.0)  # [F, 3]
     scan_hist = jnp.where(
-        (has_missing[:, None] & (jnp.arange(Bmax)[None, :] == missing_pos[:, None]))[:, :, None],
+        (has_missing[:, None] & (jnp.arange(Bmax, dtype=jnp.int32)[None, :] == missing_pos[:, None]))[:, :, None],
         0.0, fh)
 
     cum = jnp.cumsum(scan_hist, axis=1)  # [F, Bmax, 3]
@@ -324,7 +324,7 @@ def per_feature_best(fh: jax.Array, totals: jax.Array, meta: FeatureMeta,
         ok = (lc >= min_data) & (rc >= min_data) & \
              (lh >= min_hess) & (rh >= min_hess)
         # threshold t must leave at least one real bin on the right
-        tpos = jnp.arange(Bmax)[None, :]
+        tpos = jnp.arange(Bmax, dtype=jnp.int32)[None, :]
         ok &= tpos < (meta.nbins[:, None] - 1)
         ok &= meta.valid_slot
         ok &= ~meta.is_categorical[:, None]
@@ -414,14 +414,14 @@ def per_feature_best_categorical(fh: jax.Array, totals: jax.Array,
     max_onehot, max_cat_thresh = params[6], params[7]
     cat_l2, cat_smooth, min_group = params[8], params[9], params[10]
     F, Bmax, _ = fh.shape
-    rows = jnp.arange(F)
+    rows = jnp.arange(F, dtype=jnp.int32)
     total_g, total_h, total_cnt = totals[0], totals[1], totals[2]
     gain_shift = leaf_gain(total_g, total_h, l1, l2, max_delta) + min_gain
     neg_inf = jnp.float32(-jnp.inf)
     eps = jnp.float32(K_EPSILON)
 
     g, h, c = fh[..., 0], fh[..., 1], fh[..., 2]
-    bin_valid = meta.valid_slot & (jnp.arange(Bmax)[None, :]
+    bin_valid = meta.valid_slot & (jnp.arange(Bmax, dtype=jnp.int32)[None, :]
                                    < meta.nbins[:, None])
 
     # ---- one-hot lane (each bin alone goes left)
@@ -455,7 +455,7 @@ def per_feature_best_categorical(fh: jax.Array, totals: jax.Array,
         clg = jnp.cumsum(sgd, axis=1)
         clh = jnp.cumsum(shd, axis=1) + eps
         clc = jnp.cumsum(scd, axis=1)
-        pos = jnp.arange(Bmax)[None, :].astype(jnp.float32)
+        pos = jnp.arange(Bmax, dtype=jnp.float32)[None, :]
         in_range = (pos < used[:, None]) & (pos < max_num_cat[:, None])
         rh = total_h - clh
         rc = total_cnt - clc
@@ -480,7 +480,7 @@ def per_feature_best_categorical(fh: jax.Array, totals: jax.Array,
     # backward lane: reversal puts the ineligible (inf-keyed) padding first,
     # so roll each row back by (Bmax - used) to start at the LAST eligible bin
     shift = (Bmax - used)[:, None]
-    idx = (jnp.arange(Bmax)[None, :] + shift) % Bmax
+    idx = (jnp.arange(Bmax, dtype=jnp.int32)[None, :] + shift) % Bmax
     bwd_stats = tuple(jnp.take_along_axis(a, idx, axis=1)
                       for a in (sg[:, ::-1], sh[:, ::-1], sc[:, ::-1]))
     bwd = direction_scan(*bwd_stats)
@@ -503,7 +503,8 @@ def per_feature_best_categorical(fh: jax.Array, totals: jax.Array,
     lg = pick(onehot_lg, fwd[2], bwd[2])
     lh = pick(onehot_lh, fwd[3], bwd[3])
     lc = pick(onehot_lc, fwd[4], bwd[4])
-    cat_dir = pick(jnp.zeros(F), jnp.ones(F), -jnp.ones(F))
+    cat_dir = pick(jnp.zeros(F, dtype=jnp.float32), jnp.ones(F, dtype=jnp.float32),
+                   -jnp.ones(F, dtype=jnp.float32))
     l2_eff = jnp.where(lane == 0, l2, l2c)
 
     rg, rh, rc = total_g - lg, total_h - lh, total_cnt - lc
@@ -523,9 +524,9 @@ def per_feature_best_categorical(fh: jax.Array, totals: jax.Array,
         out_gain,
         jnp.where(is_valid, rows.astype(jnp.float32), -1.0),
         thresh,
-        jnp.zeros(F),  # default_left = false (CategoricalDecision)
+        jnp.zeros(F, dtype=jnp.float32),  # default_left = false (CategoricalDecision)
         lg, lh, lc, rg, rh, rc, lout, rout,
-        jnp.ones(F), cat_dir,
+        jnp.ones(F, dtype=jnp.float32), cat_dir,
     ], axis=1)
 
 

@@ -30,6 +30,7 @@ import numpy as np
 from jax.sharding import NamedSharding
 
 from ..utils.log import Log
+from ..utils.timer import global_timer
 
 _initialized = False
 
@@ -106,9 +107,12 @@ def init_distributed(config=None,
     Log.info("Joining distributed world: coordinator=%s process %d/%d",
              coordinator_address, process_id, num_processes)
     try:
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=int(num_processes),
-                                   process_id=int(process_id))
+        # the coordinator join can block for the whole cluster spin-up;
+        # make that visible in perf reports
+        with global_timer.scope("dist_init"):
+            jax.distributed.initialize(coordinator_address=coordinator_address,
+                                       num_processes=int(num_processes),
+                                       process_id=int(process_id))
     except RuntimeError as e:
         # "should only be called once" / "already initialized": fine
         if "once" not in str(e) and "already" not in str(e):

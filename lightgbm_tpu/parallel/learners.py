@@ -138,6 +138,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
                           ((0, 0), (0, self.n_pad - dataset.num_data)))
         return put_global(bins_pad, self.mesh, P(None, "data"))
 
+    # graftlint: disable=untimed-hot-func -- builder only defines jitted closures; real cost is lazy trace+compile inside the timed train() scopes
     def _build_step_fns(self) -> None:
         mesh = self.mesh
         bpad = self.group_bin_padded
@@ -346,6 +347,7 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
         self.scan_meta_full = scan_meta_of(self.meta_pad)
         self._build_voting_fns()
 
+    # graftlint: disable=untimed-hot-func -- builder only defines jitted closures; real cost is lazy trace+compile inside the timed train() scopes
     def _build_voting_fns(self) -> None:
         mesh = self.mesh
         bpad = self.group_bin_padded

@@ -94,8 +94,8 @@ def _feature_tables(dataset, used_features) -> FeatureTables:
         group[k], lo[k], hi[k] = gi, l, h
         db[k], nb[k], mt[k] = m.default_bin, m.num_bin, m.missing_type
         ie[k] = fg.is_multi
-    return FeatureTables(*(jnp.asarray(a) for a in (group, lo, hi, db, nb,
-                                                    mt, ie)))
+    return FeatureTables(*(jnp.asarray(a, dtype=a.dtype)
+                           for a in (group, lo, hi, db, nb, mt, ie)))
 
 
 from ..common import MISSING_NAN, MISSING_ZERO  # noqa: E402
@@ -229,7 +229,7 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
                 interpret=interp)
             return h[:G]
         # XLA fallback: flat slot-expanded build over the full row set
-        col_slot = jnp.arange(n_slots * CH) // CH
+        col_slot = jnp.arange(n_slots * CH, dtype=jnp.int32) // CH
         ghK = jnp.where(slot[:, None] == col_slot[None, :],
                         jnp.tile(row_c[:, :CH], (1, n_slots)), 0.0)
         h = build_histogram(bins_c[:G], ghK, num_bins)
@@ -324,7 +324,7 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         # bins[grp_row[n], n] without a gather: compare-select over the G
         # group rows (G*N elementwise beats an N-sized row-varying gather)
         gb_row = jnp.sum(
-            jnp.where(jnp.arange(Gp)[:, None] == grp_row[None, :], bins_p,
+            jnp.where(jnp.arange(Gp, dtype=jnp.int32)[:, None] == grp_row[None, :], bins_p,
                       0), axis=0, dtype=jnp.int32)
         go_left = _decide_go_left(
             gb_row, ri[:, 1], rowsF[:, 2] > 0.5, ri[:, 3], ri[:, 4],
@@ -403,7 +403,8 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
             wn = jnp.where(can, new_leaf, L)
             depth = depth.at[wb].set(nd).at[wn].set(nd)
             leaf_best = leaf_best.at[wb].set(lrec).at[wn].set(rrec)
-            leaf_best = leaf_best.at[L].set(jnp.full(REC, neg_inf))
+            leaf_best = leaf_best.at[L].set(jnp.full(REC, neg_inf,
+                                                     dtype=jnp.float32))
             row = jnp.concatenate([
                 jnp.stack([b.astype(jnp.float32), pout,
                            nd.astype(jnp.float32),
@@ -513,14 +514,15 @@ class DeviceTreeLearner(SerialTreeLearner):
         if bag_indices is not None:
             in_bag = np.zeros(self.num_data, dtype=bool)
             in_bag[np.asarray(bag_indices, dtype=np.int64)] = True
-            leaf_id0 = jnp.asarray(np.where(in_bag, 0, -1).astype(np.int32))
-            gh = jnp.where(jnp.asarray(in_bag)[:, None], gh,
+            leaf_id0 = jnp.asarray(np.where(in_bag, 0, -1), dtype=jnp.int32)
+            gh = jnp.where(jnp.asarray(in_bag, dtype=jnp.bool_)[:, None], gh,
                            jnp.zeros((), gh.dtype))
         else:
             leaf_id0 = jnp.zeros(self.num_data, dtype=jnp.int32)
 
         if self.col_sampler.active:
-            fmask = jnp.asarray(self.col_sampler.reset_by_tree())
+            fmask = jnp.asarray(self.col_sampler.reset_by_tree(),
+                                dtype=jnp.bool_)
         else:
             fmask = jnp.ones(len(self.meta.real_feature), dtype=bool)
         with global_timer.scope("tree_device"):

@@ -27,6 +27,7 @@ import numpy as np
 
 from ..common import K_ZERO_THRESHOLD
 from ..models.tree import Tree
+from ..utils.timer import global_timer
 
 
 def fit_leaf_linear_models(tree: Tree, dataset, raw: np.ndarray,
@@ -39,6 +40,7 @@ def fit_leaf_linear_models(tree: Tree, dataset, raw: np.ndarray,
     partition: the tree learner's partition (per-leaf row index sets)
     grad/hess: [N] float gradients/hessians
     """
+    global_timer.add_count("linear_leaf_fits", tree.num_leaves)
     tree.is_linear = True
     if tree.leaf_const is None:
         tree.leaf_const = np.zeros(tree.max_leaves, dtype=np.float64)

@@ -59,7 +59,8 @@ class SerialTreeLearner:
         self.dataset = dataset
         self.num_data = dataset.num_data
         # device-resident bin matrix (the CUDARowData analog)
-        self.bins_dev = self._device_bins(dataset)
+        with global_timer.scope("learner_init"):
+            self.bins_dev = self._device_bins(dataset)
         self.group_bin_padded = int(max(dataset.group_bin_counts().max(), 2))
         self.meta: FeatureMeta = make_feature_meta(dataset, self.group_bin_padded)
         self.params_dev = jnp.asarray([
@@ -160,7 +161,8 @@ class SerialTreeLearner:
         quantized training keeps the monotonicity guarantee."""
         cfg = self.config
         for leaf in range(tree.num_leaves):
-            idx = jnp.asarray(np.asarray(self.partition.indices(leaf)))
+            idx = jnp.asarray(np.asarray(self.partition.indices(leaf)),
+                              dtype=jnp.int32)
             gh = jnp.take(self._gh_float, idx, axis=0).sum(axis=0)
             sums = np.asarray(gh)
             out = _leaf_output_host(float(sums[0]), float(sums[1]),
@@ -176,7 +178,7 @@ class SerialTreeLearner:
     # these hooks; the leaf-wise control flow above is shared.
 
     def _device_bins(self, dataset: Dataset) -> jax.Array:
-        return jnp.asarray(dataset.bins)
+        return jnp.asarray(dataset.bins, dtype=dataset.bins.dtype)
 
     def _prepare_gh(self, gh_ext: jax.Array) -> jax.Array:
         """Quantize the gradient pack when use_quantized_grad is on: int8
@@ -210,7 +212,7 @@ class SerialTreeLearner:
         self.partition = partition
         if self.col_sampler.active:
             self._tree_feature_mask = jnp.asarray(
-                self.col_sampler.reset_by_tree())
+                self.col_sampler.reset_by_tree(), dtype=jnp.bool_)
         else:
             self._tree_feature_mask = None
 
@@ -230,7 +232,8 @@ class SerialTreeLearner:
         if not cs.active:
             return None
         if cs.fraction_bynode < 1.0 or cs.constraints:
-            return jnp.asarray(cs.get_by_node(set(state.features_in_path)))
+            return jnp.asarray(cs.get_by_node(set(state.features_in_path)),
+                               dtype=jnp.bool_)
         return self._tree_feature_mask
 
     def _search_split(self, state: "_LeafState", leaf: int) -> SplitInfo:
@@ -252,7 +255,8 @@ class SerialTreeLearner:
             return None
         rows = self._leaf_rows(leaf) if self.cegb.needs_rows else None
         return jnp.asarray(
-            self.cegb.penalty_vector(state.totals[2], rows))
+            self.cegb.penalty_vector(state.totals[2], rows),
+            dtype=jnp.float32)
 
     def _leaf_rows(self, leaf: int) -> np.ndarray:
         """Actual (unpadded) row indices of a leaf, for CEGB lazy tracking."""
@@ -312,6 +316,7 @@ class SerialTreeLearner:
                 queue.append((jnode["right"], new_leaf))
         return count
 
+    # graftlint: disable=untimed-hot-func -- cold path: runs only when forcedsplits_filename is set
     def _forced_split_info(self, state: "_LeafState",
                            jnode) -> Optional[SplitInfo]:
         """Split stats for a forced (feature, threshold) pair, computed from
@@ -435,7 +440,7 @@ class SerialTreeLearner:
                 parent_value=parent_output)
             mask = np.zeros(self.group_bin_padded, dtype=bool)
             mask[np.asarray(left_bins, dtype=np.int64)] = True
-            cat_mask = jnp.asarray(mask)
+            cat_mask = jnp.asarray(mask, dtype=jnp.bool_)
         else:
             threshold_double = mapper.bin_to_value(split.threshold_bin)
             tree.split(leaf=leaf, feature_inner=dense_f, real_feature=real_f,

@@ -1,0 +1,6 @@
+"""R4 fixture spec: one read param, one ghost, one suppressed."""
+PARAM_SPEC = [
+    ('used_param', 'int', 0, [], [], False),
+    ('ghost_param', 'int', 0, [], [], False),
+    ('surface_param', 'int', 0, [], [], False),  # graftlint: disable=param-unread -- fixture: reference-surface only
+]

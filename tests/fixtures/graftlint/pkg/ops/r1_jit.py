@@ -1,0 +1,24 @@
+"""R1 fixture: host syncs inside jit-reachable functions."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def jitted_entry(x):
+    n = int(x.sum())  # line 9: VIOLATION jit-host-sync (concretization)
+    helper(x)
+    return n
+
+
+def helper(x):
+    v = x.item()  # line 15: VIOLATION (reachable from jitted_entry)
+    host = np.asarray(x)  # line 16: VIOLATION (numpy escape)
+    ok = int(x.shape[0])  # shapes are trace-time static: clean
+    # graftlint: disable=jit-host-sync -- fixture: value is host-side by contract
+    quiet = float(x.mean())  # suppressed
+    return v, host, ok, quiet
+
+
+def cold(x):
+    return int(x)  # not jit-reachable: clean

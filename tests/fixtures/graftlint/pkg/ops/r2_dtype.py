@@ -1,0 +1,12 @@
+"""R2 fixture: array constructors without an explicit dtype."""
+import jax.numpy as jnp
+
+
+def build(n):
+    bad = jnp.zeros(n)  # line 6: VIOLATION implicit-dtype
+    bad2 = jnp.arange(n)  # line 7: VIOLATION implicit-dtype
+    good = jnp.ones(n, dtype=jnp.float32)  # dtype kwarg: clean
+    good2 = jnp.arange(0, n, 1, jnp.int32)  # positional dtype slot: clean
+    like = jnp.zeros_like(bad)  # *_like inherits deliberately: clean
+    quiet = jnp.asarray(n)  # graftlint: disable=R2 -- fixture: family-code suppression
+    return bad, bad2, good, good2, like, quiet

@@ -1,0 +1,36 @@
+"""R3 fixture: tile misalignment, index_map arity, host ops in kernels."""
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+TILE = 100  # deliberately unaligned: trips both tile checks when resolved
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+    np.asarray(x_ref)  # line 11: VIOLATION pallas-host-op
+    # graftlint: disable=pallas-host-op -- fixture: suppressed host op
+    print("debug")  # suppressed
+
+
+def run(x):
+    return pl.pallas_call(
+        kernel,
+        grid=(4,),
+        # line 21: two tile-shape VIOLATIONS (100 % 8, 100 % 128) + arity
+        in_specs=[pl.BlockSpec((TILE, 100), lambda i, j: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=None,
+    )(x)
+
+
+def run_prefetch(x):
+    return pl.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(2,),
+        in_specs=[
+            # graftlint: disable=R3 -- fixture: family-code suppression
+            pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, 128), lambda i, s: (i, 0)),
+    )

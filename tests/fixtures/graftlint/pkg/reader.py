@@ -1,0 +1,5 @@
+"""Reads used_param (attribute load counts as a read for R4)."""
+
+
+def apply(cfg):
+    return cfg.used_param

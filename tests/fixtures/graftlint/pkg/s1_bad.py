@@ -1,0 +1,4 @@
+"""S1 fixture: malformed suppression directives are themselves findings."""
+X = 1  # graftlint: disable=implicit-dtype
+Y = 2  # graftlint: disable=not-a-rule -- bogus rule id
+Z = 3  # graftlint: disabled=implicit-dtype -- misspelled directive

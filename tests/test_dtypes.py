@@ -1,0 +1,60 @@
+"""Dtype-discipline lock-in: the explicit dtypes graftlint R2 demanded are
+part of the device ABI. These assertions keep a future x64 flip (or a
+refactor that drops a dtype=) from silently doubling memory traffic or
+changing Mosaic tiling."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import Dataset as CoreDS
+from lightgbm_tpu.ops.partition import RowPartition
+from lightgbm_tpu.ops.predict import pack_ensemble
+from lightgbm_tpu.ops.score import binned_tree_arrays
+from lightgbm_tpu.ops.split import make_feature_meta
+from tests.test_tree import make_simple_tree
+
+
+@pytest.fixture(scope="module")
+def small_ds():
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(400, 3))
+    y = rng.normal(size=400).astype(np.float32)
+    return CoreDS.from_matrix(X, label=y, config=Config({"verbosity": -1}))
+
+
+def test_feature_meta_dtypes(small_ds):
+    meta = make_feature_meta(small_ds, int(small_ds.group_bin_counts().max()))
+    assert meta.gather_index.dtype == jnp.int32
+    assert meta.valid_slot.dtype == jnp.bool_
+    assert meta.default_bin.dtype == jnp.int32
+    assert meta.efb_omitted.dtype == jnp.bool_
+    assert meta.missing_type.dtype == jnp.int32
+    assert meta.nbins.dtype == jnp.int32
+    assert meta.is_categorical.dtype == jnp.bool_
+    assert meta.monotone.dtype == jnp.int32
+
+
+def test_binned_tree_arrays_dtypes(small_ds):
+    ta = binned_tree_arrays(make_simple_tree(), small_ds)
+    for name in ("group", "threshold", "missing_type", "default_bin",
+                 "nbins", "efb_lo", "efb_hi", "left_child", "right_child"):
+        assert getattr(ta, name).dtype == jnp.int32, name
+    assert ta.default_left.dtype == jnp.bool_
+    assert ta.is_efb.dtype == jnp.bool_
+    assert ta.leaf_value.dtype == jnp.float32
+
+
+def test_packed_ensemble_dtypes():
+    packed = pack_ensemble([make_simple_tree()])
+    for name in ("split_feature", "decision_type", "left_child",
+                 "right_child", "cat_offset", "cat_n_words", "num_leaves"):
+        assert getattr(packed, name).dtype == jnp.int32, name
+    assert packed.cat_words.dtype == jnp.uint32
+    assert packed.threshold.dtype == jnp.float32
+    assert packed.leaf_value.dtype == jnp.float32
+
+
+def test_partition_index_dtypes():
+    part = RowPartition(1000, min_bucket=256)
+    assert part.indices(0).dtype == jnp.int32

@@ -1,0 +1,205 @@
+"""graftlint self-tests: every rule fires on its fixture, suppressions with
+reasons are honored, malformed directives are findings, and the real
+package is clean.
+
+The fixture tree under tests/fixtures/graftlint/pkg mimics the package
+layout (ops/, treelearner/) so path-scoped rules apply to it unchanged.
+"""
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import run_lint, rule_codes
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "graftlint" / "pkg"
+PACKAGE = REPO / "lightgbm_tpu"
+
+
+@pytest.fixture(scope="module")
+def fixture_result():
+    return run_lint(FIXTURES)
+
+
+def _hits(result, rule, path=None, suppressed=False):
+    pool = result.suppressed if suppressed else result.violations
+    return [v for v in pool
+            if v.rule == rule and (path is None or v.path == path)]
+
+
+# -- R1 jit-boundary hygiene ---------------------------------------------
+
+def test_r1_detects_host_syncs(fixture_result):
+    lines = {v.line for v in _hits(fixture_result, "jit-host-sync",
+                                   "ops/r1_jit.py")}
+    assert lines == {9, 15, 16}  # int(tracer), .item(), np.asarray
+
+
+def test_r1_static_and_unreachable_are_clean(fixture_result):
+    # int(x.shape[0]) (line 17) and the non-jit-reachable int(x) (line 24)
+    # must not fire
+    lines = {v.line for v in _hits(fixture_result, "jit-host-sync")}
+    assert 17 not in lines and 24 not in lines
+
+
+def test_r1_suppression_honored(fixture_result):
+    sup = _hits(fixture_result, "jit-host-sync", "ops/r1_jit.py",
+                suppressed=True)
+    assert [v.line for v in sup] == [19]
+    assert "host-side by contract" in sup[0].reason
+
+
+# -- R2 dtype discipline --------------------------------------------------
+
+def test_r2_detects_implicit_dtype(fixture_result):
+    lines = {v.line for v in _hits(fixture_result, "implicit-dtype",
+                                   "ops/r2_dtype.py")}
+    assert lines == {6, 7}  # bare zeros + arange
+
+
+def test_r2_explicit_and_like_are_clean(fixture_result):
+    # dtype kwarg (8), positional dtype slot (9), zeros_like (10)
+    lines = {v.line for v in _hits(fixture_result, "implicit-dtype")}
+    assert not lines & {8, 9, 10}
+
+
+def test_r2_family_code_suppression(fixture_result):
+    sup = _hits(fixture_result, "implicit-dtype", "ops/r2_dtype.py",
+                suppressed=True)
+    assert [v.line for v in sup] == [11]  # disable=R2 covers the rule
+
+
+# -- R3 Pallas kernel rules -----------------------------------------------
+
+def test_r3_tile_shape_resolves_module_constants(fixture_result):
+    msgs = [v.message for v in _hits(fixture_result, "pallas-tile-shape",
+                                     "ops/r3_pallas.py")]
+    # TILE = 100 resolved symbolically -> both sublane and lane misaligned
+    assert len(msgs) == 2
+    assert any("multiple of 128" in m for m in msgs)
+    assert any("multiple of 8" in m for m in msgs)
+
+
+def test_r3_prefetch_arity(fixture_result):
+    bad = _hits(fixture_result, "pallas-prefetch-arity", "ops/r3_pallas.py")
+    assert len(bad) == 1 and "takes 2 args, expected 1" in bad[0].message
+    sup = _hits(fixture_result, "pallas-prefetch-arity", "ops/r3_pallas.py",
+                suppressed=True)
+    # num_scalar_prefetch=1 shifts the expected arity; disable=R3 covers it
+    assert len(sup) == 1 and "expected 2" in sup[0].message
+
+
+def test_r3_host_op_in_kernel(fixture_result):
+    bad = _hits(fixture_result, "pallas-host-op", "ops/r3_pallas.py")
+    assert [v.line for v in bad] == [11]  # np.asarray in kernel body
+    sup = _hits(fixture_result, "pallas-host-op", "ops/r3_pallas.py",
+                suppressed=True)
+    assert [v.line for v in sup] == [13]  # suppressed print()
+
+
+# -- R4 param-spec consistency --------------------------------------------
+
+def test_r4_unread_param_detected(fixture_result):
+    bad = _hits(fixture_result, "param-unread", "_param_spec.py")
+    assert len(bad) == 1 and "'ghost_param'" in bad[0].message
+
+
+def test_r4_read_param_clean_and_suppression_honored(fixture_result):
+    all_msgs = [v.message for v in
+                fixture_result.violations + fixture_result.suppressed]
+    assert not any("'used_param'" in m for m in all_msgs)
+    sup = _hits(fixture_result, "param-unread", suppressed=True)
+    assert len(sup) == 1 and "'surface_param'" in sup[0].message
+
+
+# -- R5 timer discipline --------------------------------------------------
+
+def test_r5_untimed_long_function(fixture_result):
+    bad = _hits(fixture_result, "untimed-hot-func", "treelearner/r5_big.py")
+    assert len(bad) == 1 and "'big_untimed'" in bad[0].message
+
+
+def test_r5_timed_and_jitted_exempt(fixture_result):
+    msgs = [v.message for v in
+            fixture_result.violations + fixture_result.suppressed]
+    assert not any("'big_timed'" in m for m in msgs)
+    assert not any("'big_jitted'" in m for m in msgs)
+
+
+def test_r5_suppression_honored(fixture_result):
+    sup = _hits(fixture_result, "untimed-hot-func", suppressed=True)
+    assert len(sup) == 1 and "'big_suppressed'" in sup[0].message
+
+
+# -- S1 directive hygiene -------------------------------------------------
+
+def test_s1_bad_directives_are_findings(fixture_result):
+    bad = _hits(fixture_result, "bad-suppression", "s1_bad.py")
+    msgs = {v.line: v.message for v in bad}
+    assert "without a reason" in msgs[2]
+    assert "not-a-rule" in msgs[3]
+    assert "unparseable" in msgs[4]
+
+
+def test_s1_is_never_suppressible():
+    # a reasoned disable=S1 on the same line must NOT silence the finding
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        p = Path(d) / "x.py"
+        p.write_text("A = 1  # graftlint: disable=implicit-dtype\n")
+        res = run_lint(p)
+        assert [v.rule for v in res.violations] == ["bad-suppression"]
+
+
+# -- driver behavior ------------------------------------------------------
+
+def test_select_filters_rules(fixture_result):
+    res = run_lint(FIXTURES, select=["R2"])
+    rules = {v.rule for v in res.violations}
+    # directive errors always surface; otherwise only the selected rule
+    assert rules <= {"implicit-dtype", "bad-suppression"}
+    assert "implicit-dtype" in rules
+
+
+def test_ignore_filters_rules():
+    res = run_lint(FIXTURES, ignore=["param-unread"])
+    assert not any(v.rule == "param-unread" for v in res.violations)
+
+
+def test_rule_codes_cover_names_and_codes():
+    table = rule_codes()
+    for ident in ("R1", "R2", "R3", "R4", "R5", "jit-host-sync",
+                  "implicit-dtype", "pallas-tile-shape",
+                  "pallas-prefetch-arity", "pallas-host-op",
+                  "param-unread", "untimed-hot-func"):
+        assert ident in table
+
+
+# -- the gate: the real package is clean ----------------------------------
+
+def test_package_has_zero_unsuppressed_violations():
+    res = run_lint(PACKAGE)
+    assert res.ok, "\n" + res.render()
+
+
+def test_every_package_suppression_carries_a_reason():
+    res = run_lint(PACKAGE)
+    assert all(v.reason for v in res.suppressed)
+
+
+def test_cli_exit_codes():
+    clean = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "lightgbm_tpu"],
+        cwd=REPO, capture_output=True, text=True)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    dirty = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", str(FIXTURES)],
+        cwd=REPO, capture_output=True, text=True)
+    assert dirty.returncode == 1
+    usage = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--select", "no-such-rule",
+         "lightgbm_tpu"],
+        cwd=REPO, capture_output=True, text=True)
+    assert usage.returncode == 2

@@ -1,0 +1,33 @@
+"""graftlint: AST-based JAX/Pallas invariant checker for the TPU hot path.
+
+XLA enforces none of the invariants this codebase's correctness and
+performance rest on: a host sync inside a jitted tree-growing loop
+compiles fine and silently serializes every wave; a bare `jnp.asarray`
+picks its dtype from ambient x64 state; a Pallas block shape off the
+(8, 128) Mosaic tile lowers on CPU interpret mode and explodes on real
+hardware; a config parameter nobody reads trains a silently different
+model than the reference (the `path_smooth` defect class, fixed by hand
+in PR 1). graftlint checks all of these mechanically on every commit.
+
+Rules (see docs/LINTING.md for rationale and examples):
+
+  R1 jit-host-sync        host syncs / numpy escapes in jit-reachable code
+  R2 implicit-dtype       array constructors without an explicit dtype
+  R3 pallas-tile-shape    literal BlockSpec dims off the (8, 128) tile
+     pallas-prefetch-arity index_map arity vs grid + scalar-prefetch count
+     pallas-host-op        host-only ops inside Pallas kernel bodies
+  R4 param-unread         spec parameters accepted but never read
+  R5 untimed-hot-func     >50-line hot-path functions without timer scopes
+  S1 bad-suppression      malformed / reason-less suppression comments
+
+Suppression syntax (reason REQUIRED; an empty reason is itself an S1):
+
+    x = jnp.asarray(v)  # graftlint: disable=implicit-dtype -- host literal
+
+Run as `python -m tools.graftlint lightgbm_tpu`. Pure stdlib — importing
+this package must never import jax (CI lints before deps install).
+"""
+from .core import LintResult, Violation, run_lint  # noqa: F401
+from .rules import RULES, rule_codes  # noqa: F401
+
+__all__ = ["run_lint", "LintResult", "Violation", "RULES", "rule_codes"]

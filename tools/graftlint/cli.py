@@ -1,0 +1,90 @@
+"""graftlint CLI: `python -m tools.graftlint lightgbm_tpu`.
+
+Exit status 0 = clean (suppressed findings allowed), 1 = unsuppressed
+violations, 2 = usage error. Stdlib-only by design: the CI lint job runs
+before any heavyweight dependency installs.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from .core import run_lint
+from .rules import RULES, EXTRA_IDS, rule_codes
+
+
+def _list_rules() -> str:
+    lines = ["graftlint rules:"]
+    for rule in RULES:
+        lines.append("  %-4s %-22s %s" % (rule.code, rule.name,
+                                          rule.description))
+    for name, code in sorted(EXTRA_IDS.items(), key=lambda kv: kv[1]):
+        if any(r.name == name for r in RULES):
+            continue
+        lines.append("  %-4s %-22s (sub-rule / driver-level finding)"
+                     % (code, name))
+    lines.append("")
+    lines.append("suppress a line:  # graftlint: disable=<rule>[,<rule>]"
+                 " -- <reason>")
+    lines.append("(a reason is mandatory; a bare disable is itself an S1"
+                 " violation)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="AST-based JAX/Pallas invariant checker for the TPU "
+                    "hot path (see docs/LINTING.md)")
+    parser.add_argument("paths", nargs="*",
+                        help="package directories or files to lint "
+                             "(typically: lightgbm_tpu)")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule names/codes to run "
+                             "(default: all)")
+    parser.add_argument("--ignore", default=None,
+                        help="comma-separated rule names/codes to skip")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print suppressed findings with reasons")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        print("error: no paths given (try: python -m tools.graftlint "
+              "lightgbm_tpu)", file=sys.stderr)
+        return 2
+
+    known = rule_codes()
+    for opt in (args.select, args.ignore):
+        for tok in (opt.split(",") if opt else []):
+            if tok.strip() and tok.strip() not in known:
+                print("error: unknown rule %r (see --list-rules)"
+                      % tok.strip(), file=sys.stderr)
+                return 2
+
+    select = [t.strip() for t in args.select.split(",")] if args.select \
+        else None
+    ignore = [t.strip() for t in args.ignore.split(",")] if args.ignore \
+        else None
+
+    failed = False
+    for path in args.paths:
+        p = Path(path)
+        if not p.exists():
+            print("error: no such path: %s" % path, file=sys.stderr)
+            return 2
+        result = run_lint(p, select=select, ignore=ignore)
+        print(result.render(show_suppressed=args.show_suppressed))
+        failed |= not result.ok
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

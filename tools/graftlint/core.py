@@ -1,0 +1,280 @@
+"""graftlint core: file contexts, suppression directives, the lint driver.
+
+Everything here is pure stdlib (ast + tokenize). The driver walks a
+package root, parses every .py file once, hands the parsed set to each
+rule (rules may be file-local or whole-package, like R4's param
+cross-reference), then filters the raw findings through the suppression
+table and reports what survives.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field, replace
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One finding. `rule` is the stable name, `code` the R-number."""
+
+    rule: str
+    code: str
+    path: str  # package-relative, '/'-separated
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def render(self) -> str:
+        tag = " (suppressed: %s)" % self.reason if self.suppressed else ""
+        return "%s:%d:%d: %s [%s] %s%s" % (
+            self.path, self.line, self.col, self.code, self.rule,
+            self.message, tag)
+
+
+@dataclass(frozen=True)
+class Suppression:
+    """A `# graftlint: disable=...` directive.
+
+    `standalone` directives (comment-only line) cover the NEXT source
+    line; trailing directives cover their own line. `tokens` holds the
+    raw identifiers: a rule name suppresses that rule, an R-code
+    suppresses its whole family (disable=R3 covers pallas-tile-shape,
+    pallas-prefetch-arity AND pallas-host-op), 'all' suppresses
+    everything on the line.
+    """
+
+    line: int
+    tokens: Tuple[str, ...]
+    reason: str
+    standalone: bool
+
+    def covers(self, line: int) -> bool:
+        target = self.line + 1 if self.standalone else self.line
+        return line == target
+
+    def matches(self, rule: str, code: str) -> bool:
+        return any(t in (rule, code, "all") for t in self.tokens)
+
+
+# reason separator is ' -- ' (double dash): single '-' appears inside
+# prose too often to delimit reliably.
+_DIRECTIVE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\-]*)(?:\s*--\s*(.*))?$")
+
+
+class FileContext:
+    """One parsed source file plus its suppression table."""
+
+    def __init__(self, abspath: Path, relpath: str) -> None:
+        self.abspath = abspath
+        self.relpath = relpath
+        self.source = abspath.read_text()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(self.source, filename=str(abspath))
+        except SyntaxError as exc:  # surfaced as an E0 finding by the driver
+            self.parse_error = "%s (line %s)" % (exc.msg, exc.lineno)
+        self.suppressions: List[Suppression] = []
+        self.directive_errors: List[Violation] = []
+        self._scan_directives()
+
+    # -- suppression directives ------------------------------------------
+    def _scan_directives(self) -> None:
+        from .rules import rule_codes  # local import: rules import core
+
+        known = rule_codes()  # name -> code, plus code -> name
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            comments = [(t.start[0], t.start[1], t.string)
+                        for t in tokens if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, SyntaxError):
+            return
+        for line, col, text in comments:
+            m = _DIRECTIVE.search(text)
+            if m is None:
+                if "graftlint" in text and "disable" in text:
+                    self.directive_errors.append(Violation(
+                        "bad-suppression", "S1", self.relpath, line, col,
+                        "unparseable graftlint directive: %r" % text))
+                continue
+            names = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+            reason = (m.group(2) or "").strip()
+            bad = [n for n in names if n != "all" and n not in known]
+            if not names or bad:
+                self.directive_errors.append(Violation(
+                    "bad-suppression", "S1", self.relpath, line, col,
+                    "unknown rule(s) in disable=: %s" % (", ".join(bad) or "<none>")))
+                continue
+            if not reason:
+                # the defect class R4 exists for — unexplained exceptions —
+                # applies to the linter itself: every escape hatch carries
+                # its justification next to the code it excuses.
+                self.directive_errors.append(Violation(
+                    "bad-suppression", "S1", self.relpath, line, col,
+                    "suppression without a reason (use `disable=%s -- <why>`)"
+                    % ",".join(names)))
+                continue
+            standalone = self.source.splitlines()[line - 1][:col].strip() == ""
+            self.suppressions.append(Suppression(line, names, reason, standalone))
+
+    def suppression_for(self, rule: str, code: str,
+                        line: int) -> Optional[Suppression]:
+        for s in self.suppressions:
+            if s.covers(line) and s.matches(rule, code):
+                return s
+        return None
+
+
+@dataclass
+class Package:
+    """The unit rules operate on: every parsed file under one root."""
+
+    root: Path
+    files: List[FileContext] = field(default_factory=list)
+
+    def by_relpath(self, relpath: str) -> Optional[FileContext]:
+        for ctx in self.files:
+            if ctx.relpath == relpath:
+                return ctx
+        return None
+
+
+@dataclass
+class LintResult:
+    violations: List[Violation]  # unsuppressed — these fail the build
+    suppressed: List[Violation]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def render(self, show_suppressed: bool = False) -> str:
+        lines = [v.render() for v in self.violations]
+        if show_suppressed:
+            lines += [v.render() for v in self.suppressed]
+        lines.append("graftlint: %d violation(s), %d suppressed"
+                     % (len(self.violations), len(self.suppressed)))
+        return "\n".join(lines)
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache"}
+
+
+def collect(root: Path) -> Package:
+    pkg = Package(root=root)
+    if root.is_file():
+        pkg.files.append(FileContext(root, root.name))
+        return pkg
+    for path in sorted(root.rglob("*.py")):
+        if any(part in _SKIP_DIRS for part in path.parts):
+            continue
+        rel = path.relative_to(root).as_posix()
+        pkg.files.append(FileContext(path, rel))
+    return pkg
+
+
+def run_lint(root, select: Optional[Sequence[str]] = None,
+             ignore: Optional[Sequence[str]] = None) -> LintResult:
+    """Lint every .py under `root` (a package directory or single file).
+
+    select/ignore take rule names or R-codes. Suppression directives are
+    honored per line; directives that are malformed or reason-less become
+    S1 findings themselves (never filtered by select).
+    """
+    from .rules import RULES, rule_codes
+
+    codes = rule_codes()
+
+    def _canon(names: Iterable[str]) -> Set[str]:
+        return {codes.get(n, n) for n in names}
+
+    selected = _canon(select) if select else None
+    ignored = _canon(ignore) if ignore else set()
+
+    pkg = collect(Path(root))
+    raw: List[Violation] = []
+    for ctx in pkg.files:
+        if ctx.parse_error is not None:
+            raw.append(Violation("parse-error", "E0", ctx.relpath, 1, 0,
+                                 ctx.parse_error))
+        raw.extend(ctx.directive_errors)
+    for rule in RULES:
+        if selected is not None and rule.name not in selected:
+            continue
+        if rule.name in ignored:
+            continue
+        raw.extend(rule.check(pkg))
+
+    kept: List[Violation] = []
+    suppressed: List[Violation] = []
+    for v in sorted(raw, key=lambda v: (v.path, v.line, v.col, v.rule)):
+        ctx = pkg.by_relpath(v.path)
+        sup = ctx.suppression_for(v.rule, v.code, v.line) if ctx else None
+        if sup is not None and v.rule not in ("bad-suppression", "parse-error"):
+            suppressed.append(replace(v, suppressed=True, reason=sup.reason))
+        else:
+            kept.append(v)
+    return LintResult(kept, suppressed)
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers used by several rules
+
+def dotted_name(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def literal_int(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = literal_int(node.operand)
+        return -inner if inner is not None else None
+    return None
+
+
+def keyword_arg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def in_scope(ctx: FileContext, prefixes: Sequence[str],
+             exact: Sequence[str] = ()) -> bool:
+    """Path scoping for rules. Tolerates being handed the repo root
+    instead of the package root by stripping one leading 'lightgbm_tpu/'."""
+    rel = ctx.relpath
+    if rel.startswith("lightgbm_tpu/"):
+        rel = rel[len("lightgbm_tpu/"):]
+    if rel in exact:
+        return True
+    return any(rel.startswith(p) for p in prefixes)
+
+
+def functions_with_parents(tree: ast.AST):
+    """Yield (funcdef, parent_chain) for every function in the module."""
+    def walk(node: ast.AST, chain: Tuple[ast.AST, ...]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, chain
+                yield from walk(child, chain + (child,))
+            else:
+                yield from walk(child, chain + (child,))
+    yield from walk(tree, ())

@@ -1,0 +1,45 @@
+"""Rule registry. Adding a rule = write a module with a Rule subclass,
+instantiate it here, document it in docs/LINTING.md, give it a fixture in
+tests/fixtures/graftlint/. Sub-ids (e.g. R3's pallas-prefetch-arity) are
+declared in EXTRA_IDS so suppressions and --select resolve them."""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .base import Rule
+from .dtype_discipline import DtypeDisciplineRule
+from .jit_boundary import JitBoundaryRule
+from .pallas_rules import PallasRule
+from .param_consistency import ParamConsistencyRule
+from .timer_discipline import TimerDisciplineRule
+
+RULES: List[Rule] = [
+    JitBoundaryRule(),
+    DtypeDisciplineRule(),
+    PallasRule(),
+    ParamConsistencyRule(),
+    TimerDisciplineRule(),
+]
+
+# rule name -> R-code for ids emitted by rules beyond their primary name
+EXTRA_IDS: Dict[str, str] = {
+    "pallas-prefetch-arity": "R3",
+    "pallas-host-op": "R3",
+    "bad-suppression": "S1",
+    "parse-error": "E0",
+}
+
+
+def rule_codes() -> Dict[str, str]:
+    """Map every accepted identifier (name or code) to the canonical rule
+    NAME — used by suppression parsing and --select. Codes shared by
+    several sub-rules (R3) map to the primary name; suppressing by code
+    suppresses the whole family via the 'code alias' entries below."""
+    table: Dict[str, str] = {}
+    for rule in RULES:
+        table[rule.name] = rule.name
+        table[rule.code] = rule.name
+    for name, code in EXTRA_IDS.items():
+        table[name] = name
+        table.setdefault(code, name)
+    return table

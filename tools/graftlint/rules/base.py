@@ -1,0 +1,53 @@
+"""Rule base class. A rule sees the whole Package (R4 needs cross-file
+state); file-local rules iterate `self.scoped(pkg)` and keep their scope
+predicate in one place."""
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Sequence, Tuple
+
+import ast
+
+from ..core import FileContext, Package, Violation, in_scope
+
+
+class Rule:
+    name: str = ""
+    code: str = ""
+    description: str = ""
+    # path prefixes (package-relative) + exact files this rule applies to;
+    # empty scope_prefixes + empty scope_exact = every file.
+    scope_prefixes: Sequence[str] = ()
+    scope_exact: Sequence[str] = ()
+
+    def check(self, pkg: Package) -> Iterable[Violation]:
+        raise NotImplementedError
+
+    def scoped(self, pkg: Package) -> Iterator[FileContext]:
+        for ctx in pkg.files:
+            if ctx.tree is None:
+                continue
+            if not self.scope_prefixes and not self.scope_exact:
+                yield ctx
+            elif in_scope(ctx, self.scope_prefixes, self.scope_exact):
+                yield ctx
+
+    def violation(self, ctx: FileContext, node: ast.AST, message: str,
+                  rule: str = "", code: str = "") -> Violation:
+        return Violation(rule or self.name, code or self.code, ctx.relpath,
+                         getattr(node, "lineno", 1),
+                         getattr(node, "col_offset", 0), message)
+
+
+def module_functions(tree: ast.Module) -> List[Tuple[str, ast.AST]]:
+    """(name, def) for module-level functions and class methods — the
+    granularity at which 'one function, one responsibility' rules apply.
+    Nested defs belong to their enclosing function's subtree."""
+    out: List[Tuple[str, ast.AST]] = []
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append((node.name, node))
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.append(("%s.%s" % (node.name, sub.name), sub))
+    return out

@@ -1,0 +1,66 @@
+"""R2 implicit-dtype: array constructors must name their dtype.
+
+`jnp.asarray(x)` takes its dtype from x's host dtype — which is float64 /
+int64 for plain Python floats and numpy defaults. Under JAX's default
+x64-disabled mode that silently narrows; with x64 enabled (or when a
+future config flips it) the SAME call site doubles its memory traffic and
+breaks kernels whose Mosaic tiling is dtype-dependent (int8 tiles are
+(32, 128), f32 tiles (8, 128)). The hot path never leaves dtype to
+ambient state: every constructor names it, either as the documented
+positional slot or as dtype=.
+
+`*_like` constructors inherit deliberately and are exempt.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Package, Violation, dotted_name, keyword_arg
+from .base import Rule
+
+# constructor -> index of the positional dtype slot in its signature
+_CONSTRUCTORS = {
+    "asarray": 1,   # jnp.asarray(a, dtype)
+    "array": 1,     # jnp.array(object, dtype)
+    "zeros": 1,     # jnp.zeros(shape, dtype)
+    "ones": 1,      # jnp.ones(shape, dtype)
+    "empty": 1,     # jnp.empty(shape, dtype)
+    "full": 2,      # jnp.full(shape, fill_value, dtype)
+    "arange": 3,    # jnp.arange(start, stop, step, dtype)
+    "eye": 3,       # jnp.eye(N, M, k, dtype)
+    "identity": 1,  # jnp.identity(n, dtype)
+    "linspace": 5,  # dtype is effectively kwarg-only
+}
+
+
+class DtypeDisciplineRule(Rule):
+    name = "implicit-dtype"
+    code = "R2"
+    description = ("jnp array constructor without an explicit dtype "
+                   "(positional slot or dtype=)")
+    scope_prefixes = ("ops/", "treelearner/")
+
+    def check(self, pkg: Package) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for ctx in self.scoped(pkg):
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = dotted_name(node.func)
+                if not fname.startswith("jnp."):
+                    continue
+                ctor = fname[len("jnp."):]
+                slot = _CONSTRUCTORS.get(ctor)
+                if slot is None:
+                    continue
+                if keyword_arg(node, "dtype") is not None:
+                    continue
+                if len(node.args) > slot and not any(
+                        isinstance(a, ast.Starred) for a in node.args):
+                    continue  # dtype passed positionally
+                out.append(self.violation(
+                    ctx, node,
+                    "jnp.%s without an explicit dtype — result dtype "
+                    "depends on ambient x64 state" % ctor))
+        return out

@@ -1,0 +1,140 @@
+"""R1 jit-host-sync: no host syncs or numpy escapes in jit-reachable code.
+
+A traced value hitting `int()`/`float()`/`bool()`/`.item()`/`np.asarray()`
+inside a jitted function either raises a TracerError at trace time (best
+case) or — when it sneaks in through a shape-dependent branch that only
+some configs reach — forces a device→host transfer that serializes the
+dispatch pipeline. On a remote-attached TPU one stray `.item()` in the
+tree-growing wave loop costs more than the histogram kernel it gates.
+
+Reachability is intra-module: functions decorated with `jax.jit` (bare or
+via `partial(jax.jit, ...)`) seed the set, which closes over same-module
+calls by name (including `self.method` calls) and nested defs. Cross-module
+reachability is intentionally out of scope — each hot module is linted on
+its own jitted surface (docs/LINTING.md#r1 for the escape hatch).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from ..core import Package, Violation, dotted_name
+from .base import Rule, module_functions
+
+# Call(Name) builtins that force concretization of a traced argument.
+_HOST_BUILTINS = {"int", "float", "bool", "complex"}
+# method calls that block on / transfer from device
+_HOST_METHODS = {"item", "tolist", "block_until_ready"}
+# numpy entry points that pull a traced array to host (np.asarray(tracer)
+# calls __array__, a silent transfer+sync)
+_NP_CALLS = {"asarray", "array", "copy", "save", "frombuffer"}
+_JAX_HOST = {"jax.device_get", "jax.device_put"}
+
+
+def _is_jitted(fn: ast.AST) -> bool:
+    """Decorator contains a reference to `jit` — covers @jax.jit, @jit,
+    @partial(jax.jit, ...), @functools.partial(jax.jit, static_argnames=...)."""
+    for dec in getattr(fn, "decorator_list", []):
+        for node in ast.walk(dec):
+            if isinstance(node, ast.Attribute) and node.attr == "jit":
+                return True
+            if isinstance(node, ast.Name) and node.id == "jit":
+                return True
+    return False
+
+
+def _static_under_jit(node: ast.AST) -> bool:
+    """Conservatively true when `int(x)`-style concretization is safe at
+    trace time: literals, len(), shape/ndim accesses, arithmetic thereof.
+    Anything unrecognized counts as traced (rule fires; suppress if the
+    value is genuinely host-side)."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _static_under_jit(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _static_under_jit(node.left) and _static_under_jit(node.right)
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in ("len", "min", "max") and all(
+                _static_under_jit(a) for a in node.args):
+            return True
+        return False
+    if isinstance(node, ast.Attribute) and node.attr in ("ndim", "size"):
+        return True  # static under jit: shapes are trace-time constants
+    if isinstance(node, ast.Subscript):
+        # x.shape[0] — static under jit
+        return (isinstance(node.value, ast.Attribute)
+                and node.value.attr == "shape")
+    return False
+
+
+class JitBoundaryRule(Rule):
+    name = "jit-host-sync"
+    code = "R1"
+    description = ("host sync / numpy escape (int(), .item(), np.asarray, "
+                   "...) inside a jax.jit-reachable function")
+    scope_prefixes = ("ops/", "treelearner/")
+    scope_exact = ("models/gbdt.py",)
+
+    def check(self, pkg: Package) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for ctx in self.scoped(pkg):
+            funcs = dict(module_functions(ctx.tree))
+            # short name -> qualified keys (self.foo calls resolve by attr)
+            short: Dict[str, List[str]] = {}
+            for qual in funcs:
+                short.setdefault(qual.rsplit(".", 1)[-1], []).append(qual)
+
+            def callees(fn: ast.AST) -> Set[str]:
+                found: Set[str] = set()
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    f = node.func
+                    if isinstance(f, ast.Name) and f.id in short:
+                        found.update(short[f.id])
+                    elif isinstance(f, ast.Attribute) and f.attr in short:
+                        found.update(short[f.attr])
+                return found
+
+            reachable: Set[str] = {q for q, fn in funcs.items()
+                                   if _is_jitted(fn)}
+            frontier = set(reachable)
+            while frontier:
+                nxt: Set[str] = set()
+                for qual in frontier:
+                    nxt |= callees(funcs[qual]) - reachable
+                reachable |= nxt
+                frontier = nxt
+            for qual in sorted(reachable):
+                out.extend(self._check_function(ctx, qual, funcs[qual]))
+        return out
+
+    def _check_function(self, ctx, qual: str, fn: ast.AST) -> List[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            fname = dotted_name(f)
+            if isinstance(f, ast.Name) and f.id in _HOST_BUILTINS:
+                if node.args and not all(_static_under_jit(a)
+                                         for a in node.args):
+                    out.append(self.violation(
+                        ctx, node,
+                        "%s() concretizes a traced value inside "
+                        "jit-reachable %r" % (f.id, qual)))
+            elif isinstance(f, ast.Attribute) and f.attr in _HOST_METHODS:
+                out.append(self.violation(
+                    ctx, node, ".%s() is a device->host sync inside "
+                    "jit-reachable %r" % (f.attr, qual)))
+            elif fname.startswith("np.") and fname[3:] in _NP_CALLS:
+                out.append(self.violation(
+                    ctx, node, "%s() pulls traced data to host inside "
+                    "jit-reachable %r" % (fname, qual)))
+            elif fname in _JAX_HOST:
+                out.append(self.violation(
+                    ctx, node, "%s() inside jit-reachable %r"
+                    % (fname, qual)))
+        return out

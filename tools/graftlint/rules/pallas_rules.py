@@ -1,0 +1,227 @@
+"""R3 Pallas kernel rules: tile alignment, prefetch arity, host-op bans.
+
+Three checks over every file that mentions `pl.pallas_call` (the rule
+self-scopes — any module growing a kernel is covered automatically):
+
+pallas-tile-shape
+    Literal integer dims in `pl.BlockSpec` block shapes must respect the
+    Mosaic f32 tile: last dim a multiple of 128, second-to-last a
+    multiple of 8 (guides/pallas_guide.md "Tiling Constraints"). A dim
+    of literal 1 is allowed (squeeze dims — e.g. the [tile_rows, 1] slot
+    column — lower fine). Symbolic dims are skipped: the module-level
+    constants they name (DEFAULT_TILE_ROWS=1024, COMPACT_TILE=512) are
+    resolved when they are plain `NAME = <int>` assignments in the same
+    file, so renaming a constant to an unaligned value still trips the
+    gate. Misaligned blocks don't fail under interpret-mode tests — they
+    fail on real hardware, which is exactly why a static check earns its
+    keep.
+
+pallas-prefetch-arity
+    With `PrefetchScalarGridSpec(num_scalar_prefetch=k, grid=<len-g>)`,
+    every index_map lambda must take g + k parameters (grid indices
+    first, then the scalar-prefetch refs). Getting this wrong reorders
+    which operand the kernel sees as scalar prefetch — the bug class the
+    ragged histogram's indirection tables would silently shift into.
+    Plain `pallas_call(grid=...)` index_maps must take g parameters.
+
+pallas-host-op
+    Kernel bodies (the callable handed to pallas_call, resolved through
+    one level of `_make_kernel(...)`-style factories) must not call numpy,
+    print, `.item()`, host callbacks, or data-dependent-shape jnp ops
+    (nonzero/unique) — none of these lower through Mosaic.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional
+
+from ..core import Package, Violation, dotted_name, keyword_arg, literal_int
+from .base import Rule
+
+_KERNEL_BANNED_JNP = {"nonzero", "unique", "save", "load", "unpackbits",
+                      "packbits", "asarray"}
+_KERNEL_BANNED_METHODS = {"item", "tolist", "block_until_ready"}
+_KERNEL_BANNED_DOTTED = {"jax.device_get", "jax.device_put",
+                         "jax.pure_callback", "jax.experimental.io_callback",
+                         "jax.debug.callback"}
+
+
+def _module_int_constants(tree: ast.Module) -> Dict[str, int]:
+    """NAME = <int literal> assignments at module level."""
+    out: Dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            val = literal_int(node.value)
+            if val is not None:
+                out[node.targets[0].id] = val
+    return out
+
+
+class PallasRule(Rule):
+    name = "pallas-tile-shape"  # primary id; subchecks carry their own
+    code = "R3"
+    description = ("Pallas invariants: (8, 128) block alignment, "
+                   "scalar-prefetch index_map arity, no host ops in kernels")
+
+    def check(self, pkg: Package) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for ctx in self.scoped(pkg):
+            if "pallas_call" not in ctx.source:
+                continue
+            consts = _module_int_constants(ctx.tree)
+            out.extend(self._check_block_shapes(ctx, consts))
+            out.extend(self._check_prefetch_arity(ctx))
+            out.extend(self._check_kernel_bodies(ctx))
+        return out
+
+    # -- tile alignment --------------------------------------------------
+    def _check_block_shapes(self, ctx, consts: Dict[str, int]) -> List[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and dotted_name(node.func).endswith("BlockSpec")):
+                continue
+            shape = keyword_arg(node, "block_shape")
+            if shape is None and node.args:
+                shape = node.args[0]
+            if not isinstance(shape, ast.Tuple) or len(shape.elts) < 2:
+                continue  # 1-D / symbolic whole-shape blocks: nothing to check
+
+            def resolve(el: ast.AST) -> Optional[int]:
+                v = literal_int(el)
+                if v is None and isinstance(el, ast.Name):
+                    v = consts.get(el.id)
+                return v
+
+            last = resolve(shape.elts[-1])
+            sublane = resolve(shape.elts[-2])
+            if last is not None and last != 1 and last % 128 != 0:
+                out.append(self.violation(
+                    ctx, shape, "BlockSpec last dim %d is not a multiple "
+                    "of 128 (Mosaic lane tile)" % last))
+            if sublane is not None and sublane != 1 and sublane % 8 != 0:
+                out.append(self.violation(
+                    ctx, shape, "BlockSpec second-to-last dim %d is not a "
+                    "multiple of 8 (Mosaic sublane tile)" % sublane))
+        return out
+
+    # -- scalar-prefetch arity -------------------------------------------
+    def _grid_len(self, call: ast.Call) -> Optional[int]:
+        grid = keyword_arg(call, "grid")
+        if grid is None:
+            return None
+        if isinstance(grid, ast.Tuple):
+            return len(grid.elts)
+        return 1  # grid=<scalar expr>
+
+    def _index_maps(self, call: ast.Call):
+        """(lambda, spec_kind) for every index_map in in_specs/out_specs."""
+        for kind in ("in_specs", "out_specs"):
+            specs = keyword_arg(call, kind)
+            if specs is None:
+                continue
+            elts = specs.elts if isinstance(specs, (ast.List, ast.Tuple)) \
+                else [specs]
+            for spec in elts:
+                if not isinstance(spec, ast.Call):
+                    continue
+                lam = keyword_arg(spec, "index_map")
+                if lam is None and len(spec.args) >= 2:
+                    lam = spec.args[1]
+                if isinstance(lam, ast.Lambda):
+                    yield lam, kind
+
+    def _check_prefetch_arity(self, ctx) -> List[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            if fname.endswith("PrefetchScalarGridSpec"):
+                nsp_node = keyword_arg(node, "num_scalar_prefetch")
+                nsp = literal_int(nsp_node) if nsp_node is not None else None
+                glen = self._grid_len(node)
+                if nsp is None or glen is None:
+                    continue
+                want = glen + nsp
+                label = ("%d grid indices + %d scalar-prefetch refs"
+                         % (glen, nsp))
+            elif fname.endswith("pallas_call"):
+                glen = self._grid_len(node)
+                if glen is None:
+                    continue
+                want = glen
+                label = "%d grid indices" % glen
+            else:
+                continue
+            for lam, kind in self._index_maps(node):
+                got = len(lam.args.args) + len(lam.args.posonlyargs)
+                if got != want:
+                    out.append(self.violation(
+                        ctx, lam, "%s index_map takes %d args, expected %d "
+                        "(%s) — scalar-prefetch operands come first and "
+                        "shift every index_map signature" % (
+                            kind, got, want, label),
+                        rule="pallas-prefetch-arity"))
+        return out
+
+    # -- host ops inside kernel bodies -----------------------------------
+    def _kernel_defs(self, ctx) -> List[ast.FunctionDef]:
+        """Kernels = first positional arg of pallas_call: a Name bound to a
+        def in this module, or a call to a factory whose returned inner def
+        is the kernel."""
+        defs: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.FunctionDef)}
+        kernels: List[ast.FunctionDef] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and dotted_name(node.func).endswith("pallas_call")
+                    and node.args):
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Name) and first.id in defs:
+                kernels.append(defs[first.id])
+            elif isinstance(first, ast.Call):
+                fac = dotted_name(first.func).rsplit(".", 1)[-1]
+                factory = defs.get(fac)
+                if factory is None:
+                    continue
+                returned = {n.value.id for n in ast.walk(factory)
+                            if isinstance(n, ast.Return)
+                            and isinstance(n.value, ast.Name)}
+                for inner in ast.walk(factory):
+                    if isinstance(inner, ast.FunctionDef) \
+                            and inner.name in returned:
+                        kernels.append(inner)
+        return kernels
+
+    def _check_kernel_bodies(self, ctx) -> List[Violation]:
+        out: List[Violation] = []
+        for kern in self._kernel_defs(ctx):
+            for node in ast.walk(kern):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                fname = dotted_name(f)
+                msg = None
+                if fname.startswith("np."):
+                    msg = "numpy call %s() in Pallas kernel %r" % (
+                        fname, kern.name)
+                elif isinstance(f, ast.Name) and f.id == "print":
+                    msg = ("print() in Pallas kernel %r (use "
+                           "pl.debug_print)" % kern.name)
+                elif isinstance(f, ast.Attribute) \
+                        and f.attr in _KERNEL_BANNED_METHODS:
+                    msg = ".%s() in Pallas kernel %r" % (f.attr, kern.name)
+                elif fname in _KERNEL_BANNED_DOTTED:
+                    msg = "%s() in Pallas kernel %r" % (fname, kern.name)
+                elif fname.startswith("jnp.") \
+                        and fname[4:] in _KERNEL_BANNED_JNP:
+                    msg = ("%s() in Pallas kernel %r does not lower "
+                           "through Mosaic" % (fname, kern.name))
+                if msg:
+                    out.append(self.violation(ctx, node, msg,
+                                              rule="pallas-host-op"))
+        return out

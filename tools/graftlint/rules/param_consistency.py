@@ -1,0 +1,102 @@
+"""R4 param-unread: every accepted parameter must be read somewhere.
+
+The defect class PR 1 fixed by hand: `path_smooth` and `monotone_penalty`
+were accepted by Config (spec-parity with the reference), silently ignored
+by the learner, and the trained model quietly differed from the reference.
+Nothing crashes — the worst kind of bug. This rule generalizes the fix:
+cross-reference the extracted parameter spec (`_param_spec.py`, the output
+of tools/extract_param_spec.py that config.py consumes) against actual
+reads across the package, and fail on accepted-but-never-read names.
+
+A "read" is any of:
+  * an attribute load `<expr>.<param>` anywhere outside _param_spec.py
+    (Config exposes every param as an attribute, so `cfg.num_leaves`,
+    `self.config.max_depth`, `config.feature_fraction` all count);
+  * `getattr(obj, "<param>", ...)`;
+  * the name as a string literal (subscripts like params["metric"],
+    warning text that explicitly declares the param ignored — the
+    PR 1 pattern of warning loudly IS an acknowledged read).
+
+Intentionally-unread params (reference-parity surface the TPU port will
+never use, e.g. gpu_platform_id) carry line suppressions with reasons in
+_param_spec.py — visible in the same file that admits them to the API.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from ..core import Package, Violation, dotted_name
+from .base import Rule
+
+_SPEC_FILENAME = "_param_spec.py"
+_SPEC_VAR = "PARAM_SPEC"
+
+
+def _spec_entries(tree: ast.Module) -> Dict[str, ast.AST]:
+    """param name -> the spec tuple node (for line numbers)."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == _SPEC_VAR \
+                and isinstance(node.value, (ast.List, ast.Tuple)):
+            out: Dict[str, ast.AST] = {}
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Tuple) and elt.elts \
+                        and isinstance(elt.elts[0], ast.Constant) \
+                        and isinstance(elt.elts[0].value, str):
+                    out[elt.elts[0].value] = elt
+            return out
+    return {}
+
+
+def _reads(tree: ast.AST, names: Set[str]) -> Set[str]:
+    found: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in names:
+            found.add(node.attr)
+        elif isinstance(node, ast.Call) and dotted_name(node.func) == "getattr" \
+                and len(node.args) >= 2 \
+                and isinstance(node.args[1], ast.Constant) \
+                and node.args[1].value in names:
+            found.add(node.args[1].value)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if node.value in names:
+                found.add(node.value)
+    return found
+
+
+class ParamConsistencyRule(Rule):
+    name = "param-unread"
+    code = "R4"
+    description = ("parameter accepted by the spec/config but never read "
+                   "anywhere in the package (the path_smooth defect class)")
+
+    def check(self, pkg: Package) -> Iterable[Violation]:
+        spec_ctx = None
+        for ctx in pkg.files:
+            if ctx.relpath.endswith(_SPEC_FILENAME) and ctx.tree is not None:
+                spec_ctx = ctx
+                break
+        if spec_ctx is None:
+            return []  # nothing to cross-reference (fixture dirs, subtrees)
+        entries = _spec_entries(spec_ctx.tree)
+        if not entries:
+            return []
+        names = set(entries)
+        read: Set[str] = set()
+        for ctx in pkg.files:
+            if ctx is spec_ctx or ctx.tree is None:
+                continue
+            read |= _reads(ctx.tree, names)
+            if read == names:
+                break
+        out: List[Violation] = []
+        for name in sorted(names - read):
+            out.append(self.violation(
+                spec_ctx, entries[name],
+                "parameter %r is accepted by the spec but never read by "
+                "any module — it will be silently ignored at train time "
+                "(read it, warn about it at config time, or suppress here "
+                "with the reason it stays surface-only)" % name))
+        return out
