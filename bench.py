@@ -114,6 +114,36 @@ def _auc(y, score) -> float:
                  / (n_pos * n_neg))
 
 
+def _wave_traffic_fields(ds) -> dict:
+    """HBM-traffic instrumentation for the bandwidth model in
+    docs/PERF_NOTES.md: rows actually histogrammed (a counter the device
+    learner publishes) and the bytes of loop carry each wave drags through
+    HBM. Both fields are ALWAYS present — when the run never dispatched the
+    device learner (CPU fallback benches use the serial learner), the
+    carry estimate is recomputed from the dataset shape with the same
+    formula DeviceTreeLearner._record_carry_bytes uses, and the row
+    counter reports 0.
+    """
+    from lightgbm_tpu.utils.timer import global_timer
+
+    fields = {"device_hist_rows":
+              int(global_timer.counters.get("device_hist_rows", 0))}
+    carry = global_timer.counters.get("device_carry_bytes_per_wave")
+    if carry is None:
+        from lightgbm_tpu.ops.compact_pallas import COMPACT_TILE
+        from lightgbm_tpu.ops.hist_pallas import DEFAULT_TILE_ROWS
+
+        core = ds._handle
+        unit = max(DEFAULT_TILE_ROWS, COMPACT_TILE)
+        np_rows = -(-core.num_data // unit) * unit
+        g = core.bins.shape[0]
+        plane_b = 1 if core.bins.dtype.itemsize == 1 else 4
+        gp = -(-g // 32) * 32 if plane_b == 1 else -(-g // 8) * 8
+        carry = gp * np_rows * plane_b + np_rows * 5 * 4
+    fields["est_carried_bytes_per_wave"] = int(carry)
+    return fields
+
+
 def run_bench(n_rows: int) -> dict:
     import lightgbm_tpu as lgb
 
@@ -142,6 +172,7 @@ def run_bench(n_rows: int) -> dict:
     out = {"row_iters_per_sec": rips, "elapsed_s": elapsed, "rows": n_rows,
            "iters": N_ITERS,
            "auc": round(_auc(yh, bst.predict(Xh)), 4)}
+    out.update(_wave_traffic_fields(ds))
 
     # secondary quantized capture defaults ON only at moderate sizes — at
     # full HIGGS scale it would double the remote-compile + train time and
@@ -207,7 +238,8 @@ def main() -> None:
             record["rows"] = res["rows"]
             record["iters"] = res["iters"]
             for k in ("auc", "quantized_row_iters_per_sec", "quantized_auc",
-                      "quantized_error"):
+                      "quantized_error", "device_hist_rows",
+                      "est_carried_bytes_per_wave"):
                 if k in res:
                     record[k] = res[k]
             emit(record)

@@ -9,6 +9,8 @@ partition's per-leaf index sets (the CUDAScoreUpdater analog).
 """
 from __future__ import annotations
 
+import os
+from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -36,6 +38,41 @@ def _pack_gh(grad: jax.Array, hess: jax.Array) -> jax.Array:
     """[N] grad/hess -> [N+1, 3] with count channel and zero sentinel row."""
     gh = jnp.stack([grad, hess, jnp.ones_like(grad)], axis=1)
     return jnp.concatenate([gh, jnp.zeros((1, 3), gh.dtype)], axis=0)
+
+
+# score is donated: the caller replaces it with the returned array, so XLA
+# updates the [N] vector in place instead of double buffering it.
+@partial(jax.jit, static_argnames=("num_leaves",), donate_argnums=(0,))
+def _apply_split_log_to_score(score: jax.Array, rec_store: jax.Array,
+                              leaf_ids: jax.Array, rate: jax.Array,
+                              num_leaves: int) -> jax.Array:
+    """Tree-t score update straight from the DEVICE split log — the async
+    pipeline's replacement for the host-side leaf-value gather, applied
+    before the log ever reaches the host.
+
+    rec_store rows are [leaf, parent_output, depth, valid] + SPLIT_FIELDS;
+    valid row t re-splits leaf `rec[0]` (left child keeps the id, right
+    child becomes leaf t+1), so replaying left_output/right_output (store
+    cols 14/15) into a leaf-value table reproduces tree.leaf_value exactly.
+    Rows past the first invalid row are all-zero (valid == 0) and write to
+    the dump slot. The f32 multiply by `rate` is bit-identical to the host
+    path's f64 shrink + f32 cast whenever rate is exactly representable in
+    f32 — _async_enabled gates on that. A stub tree (no valid rows) yields
+    an all-zero table: the update is exactly a no-op."""
+    L = num_leaves
+
+    def body(t, lv):
+        row = rec_store[t]
+        valid = row[3] > 0.5
+        wb = jnp.where(valid, row[0].astype(jnp.int32), L)
+        wn = jnp.where(valid, t + 1, L)
+        return lv.at[wb].set(row[14]).at[wn].set(row[15])
+
+    lv = jax.lax.fori_loop(0, rec_store.shape[0], body,
+                           jnp.zeros(L + 1, jnp.float32))
+    lv = lv[:L] * rate
+    return score + jnp.where(
+        leaf_ids >= 0, lv[jnp.clip(leaf_ids, 0, L - 1)], 0.0)
 
 
 class _ValidData:
@@ -73,6 +110,10 @@ class GBDT:
         self._packed_cache = None
         self.valid_sets: List[_ValidData] = []
         self.valid_names: List[str] = []
+        # async per-tree pipeline state (device learner only): the pending
+        # handle of the last dispatched tree, finalized one iteration later
+        self._pending = None
+        self._async_stub_stop = False
 
         if train_set is not None:
             n = train_set.num_data
@@ -150,10 +191,84 @@ class GBDT:
                 return init
         return 0.0
 
+    # --------------------------------------------------- async tree pipeline
+
+    def _async_enabled(self) -> bool:
+        """Eligibility gate for the async per-tree pipeline: the device
+        learner's train_async/finalize split overlaps tree t's on-device
+        growth with the host replay of tree t-1. Every condition below
+        protects BIT-IDENTICAL semantics with the sync path:
+
+        * plain GBDT, one tree per iteration, no linear leaves — subclasses
+          (DART/RF) reorder score updates around training;
+        * DeviceTreeLearner, unquantized — quantized renewal rewrites leaf
+          values after replay and reads per-tree host state;
+        * objective present with the BASE renew_tree_output no-op (L1-style
+          objectives refit leaf values on the host before the score update);
+        * the learning rate is exactly representable in f32, so the device
+          f32 (leaf * rate) equals the host f64 shrink + f32 cast bit for
+          bit. LGBM_TPU_ASYNC=1 forces the pipeline on regardless of the
+          rate; LGBM_TPU_ASYNC=0 disables it."""
+        env = os.environ.get("LGBM_TPU_ASYNC", "")
+        if env == "0":
+            return False
+        from ..treelearner.device import DeviceTreeLearner
+
+        learner = getattr(self, "tree_learner", None)
+        if not isinstance(learner, DeviceTreeLearner) or learner.quantized:
+            return False
+        if type(self) is not GBDT:
+            return False
+        if self.num_tree_per_iteration != 1 or self.config.linear_tree:
+            return False
+        if not self.class_need_train[0] or self.train_set.num_features <= 0:
+            return False
+        obj = self.objective
+        if obj is None or (type(obj).renew_tree_output
+                           is not ObjectiveFunction.renew_tree_output):
+            return False
+        if env == "1":
+            return True
+        rate = float(self.shrinkage_rate)
+        return float(np.float32(rate)) == rate
+
+    def _flush_pending(self) -> None:
+        """Finalize the in-flight tree, if any: replay its split log into
+        the placeholder Tree already sitting in self.models, shrink it, and
+        apply the deferred valid-score updates. A stub (no splits found)
+        unwinds the whole iteration — the placeholder is removed and iter_
+        decremented — and raises the _async_stub_stop flag so the next
+        train_one_iter reports is_finished, matching the sync stop one
+        iteration late. Called from every state reader (eval, predict,
+        rollback, refit, export) and from the sync training path."""
+        pending = self._pending
+        if pending is None:
+            return
+        self._pending = None
+        with global_timer.scope("tree_train"):
+            tree = self.tree_learner.finalize(pending)
+        if tree.num_leaves <= 1:
+            for i in range(len(self.models) - 1, -1, -1):
+                if self.models[i] is tree:
+                    del self.models[i]
+                    break
+            self.iter_ -= 1
+            self._packed_cache = None
+            self._async_stub_stop = True
+            return
+        tree.shrink(self.shrinkage_rate)
+        with global_timer.scope("update_score"):
+            self._update_valid_scores(tree, 0)
+
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
         """Returns True when training should STOP (no more valid splits) —
         matching LGBM_BoosterUpdateOneIter's is_finished flag."""
+        if self._async_stub_stop:
+            self._async_stub_stop = False
+            Log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            return True
         C = self.num_tree_per_iteration
         init_scores = [0.0] * C
         custom = gradients is not None
@@ -178,6 +293,18 @@ class GBDT:
             bag, grads, hesses = self.sample_strategy.bagging(
                 self.iter_, grads, hesses)
             self._refresh_bag_cache(bag)
+        # async pipeline: not on the first iteration (its stub path seeds
+        # init scores) and not under bagging (OOB updates need the host
+        # tree before the next gradient pass)
+        if (not custom and bag is None and len(self.models) >= C
+                and self._async_enabled()):
+            return self._train_one_iter_async(grads, hesses)
+        self._flush_pending()
+        if self._async_stub_stop:
+            self._async_stub_stop = False
+            Log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            return True
         for c in range(C):
             with global_timer.scope("boosting"):
                 if C > 1:
@@ -230,6 +357,38 @@ class GBDT:
             if len(self.models) > C:
                 del self.models[-C:]
             return True
+        self.iter_ += 1
+        return False
+
+    def _train_one_iter_async(self, grads: jax.Array,
+                              hesses: jax.Array) -> bool:
+        """One async-pipelined iteration (eligibility checked by caller):
+        dispatch tree t, apply its score update straight from the device
+        split log, then — while the device is still growing tree t —
+        host-replay tree t-1's log into its placeholder Tree. The only
+        blocking transfer per iteration is t-1's split log, which has been
+        copying since its dispatch. Semantics stay bit-identical to the
+        sync path; only the stop on a no-split tree lands one iteration
+        late (the extra dispatched tree is provably the same stub with a
+        zero score delta, and is dropped)."""
+        with global_timer.scope("boosting"):
+            gh_ext = _pack_gh(grads, hesses)
+        with global_timer.scope("tree_train"):
+            pending = self.tree_learner.train_async(gh_ext, None)
+        with global_timer.scope("update_score"):
+            self.score = self.score.at[0].set(_apply_split_log_to_score(
+                self.score[0], pending.rec_store, pending.leaf_id,
+                jnp.float32(self.shrinkage_rate), self.config.num_leaves))
+        self.models.append(pending.tree)
+        self._packed_cache = None
+        self._flush_pending()  # overlaps t-1's replay with t's growth
+        if self._async_stub_stop:
+            self._async_stub_stop = False
+            self.models.pop()  # tree t: same gradients => the same stub
+            Log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            return True
+        self._pending = pending
         self.iter_ += 1
         return False
 
@@ -335,6 +494,7 @@ class GBDT:
     # ------------------------------------------------------------------- eval
 
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        self._flush_pending()
         out = []
         for m in self.train_metrics:
             for name, val in zip(m.name, m.eval(self.score[0] if self.num_tree_per_iteration == 1
@@ -343,6 +503,7 @@ class GBDT:
         return out
 
     def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
+        self._flush_pending()
         out = []
         for vname, vd in zip(self.valid_names, self.valid_sets):
             for m in vd.metrics:
@@ -354,6 +515,7 @@ class GBDT:
     # ---------------------------------------------------------------- predict
 
     def _packed(self, num_iteration: int = 0, start_iteration: int = 0):
+        self._flush_pending()
         C = self.num_tree_per_iteration
         start = max(start_iteration, 0) * C
         n_trees = len(self.models)
@@ -407,6 +569,7 @@ class GBDT:
         (SerialTreeLearner::FitByExistingTree, serial_tree_learner.cpp:250-283
         — per-leaf sums here are one device scatter-add per tree).
         """
+        self._flush_pending()
         C = self.num_tree_per_iteration
         T = len(self.models)
         if pred_leaf.shape != (self.num_data, T):
@@ -443,6 +606,7 @@ class GBDT:
     def rollback_one_iter(self) -> None:
         """RollbackOneIter (gbdt.cpp:462): drop the last iteration's trees and
         back out their score contributions."""
+        self._flush_pending()
         if self.iter_ <= 0:
             return
         C = self.num_tree_per_iteration
@@ -459,6 +623,7 @@ class GBDT:
         self._packed_cache = None
 
     def to_model(self) -> GBDTModel:
+        self._flush_pending()
         ds = self.train_set
         model = GBDTModel()
         model.num_class = self.num_class

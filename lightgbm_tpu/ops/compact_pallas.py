@@ -27,15 +27,20 @@ global scatter, so the same data movement is phrased as dense tile algebra:
      a scalar-prefetched copy flag routes untouched tiles through a plain
      VPU copy with no matmul.
 
-Exactness: values transit the MXU as four 8-bit limbs of their raw bits
-(bf16 operands — 0/1 one-hot and limbs <= 255 are exact in bf16, and each
-output row receives exactly ONE source row), so arbitrary f32/int32 payloads
-are moved bit-exactly at full bf16 MXU rate. No lax.sort anywhere: at 10.5M
-rows a global sort costs more than the histograms it would save
+Exactness: values transit the MXU as 8-bit limbs of their raw bits (bf16
+operands — 0/1 one-hot and limbs <= 255 are exact in bf16, and each output
+row receives exactly ONE source row), so payloads are moved bit-exactly at
+full bf16 MXU rate: f32 rows as four limbs, the bin plane as two limbs for
+int32 (values < 2**16) or ONE limb when the plane is already 8-bit (uint8
+bins, values <= 255) — a 2x cut in the plane's transport matmuls on top of
+the 4x HBM cut of the narrow plane itself. The only lax.sort is the single
+composite-key sort ordering the pair list; no row-wise sort anywhere — at
+10.5M rows a global row sort costs more than the histograms it would save
 (docs/PERF_NOTES.md).
 """
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import List, Sequence, Tuple
 
@@ -115,11 +120,21 @@ def build_pair_tables(dst: jax.Array, class_masks: Sequence[jax.Array],
     dst [N] int32 forward permutation; class_masks: disjoint row sets whose
     destinations are contiguous PER TILE (e.g. left rows of one range);
     moved [N] bool = union of class masks (rows whose dst may differ from
-    their position). Returns (pair_in, pair_out, is_copy, n_pairs[1]) with
+    their position). Returns (pair_in, pair_out, pcopy, n_pairs[1]) with
     static length max_pairs_bound(T, len(class_masks)); entries past
     n_pairs repeat the last real pair (same blocks -> the kernel skips DMA
-    and compute for them). Sorted by out_tile so the kernel revisits each
-    output block in one consecutive run.
+    and compute for them). pcopy per pair: 0 = one-hot permute, 1 = raw
+    block copy (untouched identity tile), 2 = SKIP (duplicate of the
+    previous pair — processing it would double-count rows). Sorted by
+    out_tile so the kernel revisits each output block in one consecutive
+    run.
+
+    One fused lax.sort: candidate pairs (with duplicates still in) are
+    sorted by the composite key out_tile*T + in_tile, so duplicates —
+    which always share an input tile AND an output tile — land adjacent
+    and are demoted to skip pairs by one post-sort compare. The previous
+    formulation pre-deduplicated with a second per-tile jnp.sort of the
+    candidate matrix; the fused key sort removes that whole pass.
     """
     N = dst.shape[0]
     T = N // tile
@@ -139,33 +154,36 @@ def build_pair_tables(dst: jax.Array, class_masks: Sequence[jax.Array],
         c1 = jnp.where(any_m & (dmax > dmin), dmax, T)
         cands.append(jnp.stack([c0, c1], axis=1))
     cand = jnp.concatenate(cands, axis=1)  # [T, 1 + 2*len(masks)]
-    # de-duplicate per input tile (duplicate pairs would double-count rows)
-    cs = jnp.sort(cand, axis=1)
-    dup = jnp.concatenate([jnp.zeros((T, 1), bool), cs[:, 1:] == cs[:, :-1]],
-                          axis=1)
-    cs = jnp.where(dup | (cs >= T), T, cs)
-    out_flat = cs.reshape(-1)
-    in_flat = jnp.repeat(ids, cs.shape[1])
+    out_flat = cand.reshape(-1)
+    in_flat = jnp.repeat(ids, cand.shape[1])
     ok = out_flat < T
     key = jnp.where(ok, out_flat * T + in_flat, big)
     key = jax.lax.sort(key)
     n_pairs = ok.sum().astype(jnp.int32)
+    # duplicate pairs (same in AND out tile => equal keys, now adjacent)
+    # become skip pairs: they stay in the list so the length stays static,
+    # but the kernel must not process them (double-counted rows). They
+    # share both blocks with their predecessor, so they cost no extra DMA.
+    dup = jnp.concatenate([jnp.zeros(1, bool), key[1:] == key[:-1]])
     mp = max_pairs_bound(T, len(class_masks))
     if key.shape[0] < mp:
-        key = jnp.concatenate([key, jnp.full(mp - key.shape[0], big,
-                                             jnp.int32)])
+        pad_n = mp - key.shape[0]
+        key = jnp.concatenate([key, jnp.full(pad_n, big, jnp.int32)])
+        dup = jnp.concatenate([dup, jnp.zeros(pad_n, bool)])
     key = key[:mp]
+    dup = dup[:mp]
     last = jnp.take(key, jnp.maximum(n_pairs - 1, 0))
-    key = jnp.where(jnp.arange(mp, dtype=jnp.int32) < n_pairs, key, last)
+    live = jnp.arange(mp, dtype=jnp.int32) < n_pairs
+    key = jnp.where(live, key, last)
     pair_in = key % T
     pair_out = key // T
     # untouched tiles: identity pair does a raw block copy, no matmul.
     # (A tile receiving rows from elsewhere necessarily lost rows too —
     # dst is a permutation — so untouched tiles exchange nothing.)
     touched = moved.reshape(T, tile).any(axis=1)
-    is_copy = ((pair_in == pair_out)
-               & ~jnp.take(touched, pair_in)).astype(jnp.int32)
-    return pair_in, pair_out, is_copy, n_pairs[None]
+    is_copy = (pair_in == pair_out) & ~jnp.take(touched, pair_in)
+    pcopy = jnp.where(dup & live, 2, is_copy.astype(jnp.int32))
+    return pair_in, pair_out, pcopy, n_pairs[None]
 
 
 def _limbs(x_int: jax.Array, n: int, axis: int) -> jax.Array:
@@ -176,14 +194,22 @@ def _limbs(x_int: jax.Array, n: int, axis: int) -> jax.Array:
     return jnp.concatenate(parts, axis=axis)
 
 
-def _make_compact_kernel(tile: int, gp: int, rc: int):
+def _make_compact_kernel(tile: int, gp: int, rc: int, plane8: bool):
+    """plane8: the bin plane is an 8-bit dtype (uint8). Its values fit one
+    bf16 limb, so the plane transports through ONE matmul instead of two,
+    and the accumulate widens to i32 in-register (Mosaic has no elementwise
+    8-bit vectors) before narrowing back to the 8-bit output block."""
+
     def kernel(pin_ref, pout_ref, pcopy_ref, npair_ref,
                bins_ref, row_ref, dst_ref, bins_out, row_out):
         p = pl.program_id(0)
         out_t = pout_ref[p]
         first = (p == 0) | (out_t != pout_ref[jnp.maximum(p - 1, 0)])
-        active = p < npair_ref[0]
-        is_copy = pcopy_ref[p] > 0
+        # pcopy == 2: duplicate pair demoted to a skip by build_pair_tables
+        # (a duplicate is never the first pair of its output block, so the
+        # zero-init below cannot be skipped by accident)
+        active = (p < npair_ref[0]) & (pcopy_ref[p] < 2)
+        is_copy = pcopy_ref[p] == 1
 
         @pl.when(active & is_copy)
         def _copy():  # untouched tile: single pair for this block, plain copy
@@ -216,21 +242,32 @@ def _make_compact_kernel(tile: int, gp: int, rc: int):
             # rows not sourced by this pair recombine to bits 0 == +0.0f;
             # f32 += 0.0 is exact, so cross-pair accumulation is bit-exact
             row_out[...] += jax.lax.bitcast_convert_type(obits, jnp.float32)
-            bl = _limbs(bins_ref[...], 2, axis=0).astype(jnp.bfloat16)
-            obl = jax.lax.dot_general(
-                bl, P, dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32).astype(jnp.int32)
-            bins_out[...] += obl[:gp] | (obl[gp:] << 8)
+            if plane8:
+                # single limb: values <= 255 are exact bf16 operands
+                bl = bins_ref[...].astype(jnp.int32).astype(jnp.bfloat16)
+                obl = jax.lax.dot_general(
+                    bl, P, dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32).astype(jnp.int32)
+                bins_out[...] = (bins_out[...].astype(jnp.int32)
+                                 + obl).astype(bins_out.dtype)
+            else:
+                bl = _limbs(bins_ref[...], 2, axis=0).astype(jnp.bfloat16)
+                obl = jax.lax.dot_general(
+                    bl, P, dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32).astype(jnp.int32)
+                bins_out[...] += obl[:gp] | (obl[gp:] << 8)
 
     return kernel
 
 
-@partial(jax.jit, static_argnames=("tile", "interpret"))
+@partial(jax.jit, static_argnames=("tile", "interpret", "alias"))
 def _pallas_compact_call(bins_p, row_p, dst, pair_in, pair_out, is_copy,
-                         n_pairs, tile: int, interpret: bool):
+                         n_pairs, tile: int, interpret: bool,
+                         alias: bool = False):
     Gp, N = bins_p.shape
     rc = row_p.shape[1]
     mp = pair_in.shape[0]
+    plane8 = bins_p.dtype.itemsize == 1
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(mp,),
@@ -244,14 +281,25 @@ def _pallas_compact_call(bins_p, row_p, dst, pair_in, pair_out, is_copy,
             pl.BlockSpec((tile, rc), lambda p, pi, po, pc, npr: (po[p], 0)),
         ],
     )
+    kwargs = {}
+    if alias:
+        # LGBM_TPU_COMPACT_ALIAS=1: reuse the bins/row input buffers as the
+        # outputs (no double buffering of the two largest carries). Indices
+        # count the 4 scalar-prefetch operands first. UNSAFE in general: a
+        # pair whose in_tile < out_tile reads its input tile after the
+        # aliased output tile has already been flushed over it. Safe only
+        # when the runtime keeps a private copy or the permutation never
+        # moves rows to a later tile than any unread source — hence opt-in.
+        kwargs["input_output_aliases"] = {4: 0, 5: 1}
     return pl.pallas_call(
-        _make_compact_kernel(tile, Gp, rc),
+        _make_compact_kernel(tile, Gp, rc, plane8),
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((Gp, N), jnp.int32),
+            jax.ShapeDtypeStruct((Gp, N), bins_p.dtype),
             jax.ShapeDtypeStruct((N, rc), jnp.float32),
         ],
         interpret=interpret,
+        **kwargs,
     )(pair_in, pair_out, is_copy, n_pairs, bins_p, row_p,
       dst.reshape(N, 1))
 
@@ -260,13 +308,18 @@ def compact_rows(bins_p: jax.Array, row_p: jax.Array, dst: jax.Array,
                  class_masks: Sequence[jax.Array], moved: jax.Array,
                  *, tile: int = COMPACT_TILE, use_pallas: bool = True,
                  interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
-    """Apply the forward permutation dst to bins_p [Gp, N] (int32,
-    values < 2**16) and row_p [N, rc] (f32 payload, moved bit-exactly).
+    """Apply the forward permutation dst to bins_p [Gp, N] (uint8, or int32
+    with values < 2**16) and row_p [N, rc] (f32 payload, moved bit-exactly).
+    The output bin plane keeps bins_p's dtype.
 
-    Pallas path requirements: N % tile == 0, Gp % 8 == 0, class_masks
-    disjoint with per-tile-contiguous destinations (range_partition_dst
-    output qualifies), moved == union(class_masks). The XLA path is a plain
-    permutation scatter — exact on CPU, used when no TPU backend is live.
+    Pallas path requirements: N % tile == 0, Gp % 8 == 0 for int32 planes
+    and Gp % 32 == 0 for 8-bit planes (Mosaic (32, 128) tiling),
+    class_masks disjoint with per-tile-contiguous destinations
+    (range_partition_dst output qualifies), moved == union(class_masks).
+    The XLA path is a plain permutation scatter — exact on CPU, used when
+    no TPU backend is live. LGBM_TPU_COMPACT_ALIAS=1 opts in to
+    input/output buffer aliasing on the pallas_call (see
+    _pallas_compact_call for the hazard).
     """
     if not use_pallas:
         bins_o = jnp.zeros_like(bins_p).at[:, dst].set(
@@ -275,6 +328,7 @@ def compact_rows(bins_p: jax.Array, row_p: jax.Array, dst: jax.Array,
         return bins_o, row_o
     pair_in, pair_out, is_copy, n_pairs = build_pair_tables(
         dst, class_masks, moved, tile)
+    alias = os.environ.get("LGBM_TPU_COMPACT_ALIAS", "") == "1"
     return _pallas_compact_call(bins_p, row_p.astype(jnp.float32),
                                 dst.astype(jnp.int32), pair_in, pair_out,
-                                is_copy, n_pairs, tile, interpret)
+                                is_copy, n_pairs, tile, interpret, alias)

@@ -12,13 +12,16 @@ irreducible G*N*(bins + gh) bytes:
         onehot[TN, B] = (bins_tile[g][:, None] == iota)   # VPU, VMEM only
         out[g] += gh_tile^T @ onehot                      # MXU, [CH, B]
 
-GB is chosen per call by _group_block: as large as the output block fits
-comfortably in VMEM (32 -> 16 -> 8; bigger blocks amortize per-grid-step
-work), never below 8 — Mosaic requires the second-to-last block dim to be
-a multiple of 8 (or the full array dim); a (1, TN) bins block fails to
-lower on real TPU hardware. The output block for a group slab is revisited
-across the N tiles (TPU grids run sequentially), accumulating in VMEM;
-step 0 zero-initializes.
+GB is chosen per call by _prep_bins/_group_block: as large as the output
+block fits comfortably in VMEM (32 -> 16 -> 8; bigger blocks amortize
+per-grid-step work), never below 8 — Mosaic requires the second-to-last
+block dim to be a multiple of 8 (or the full array dim); a (1, TN) bins
+block fails to lower on real TPU hardware. 8-bit bin planes (uint8) pass
+through unwidened — 4x less HBM traffic for the dominant [G, N] array —
+with GB pinned to 32 (Mosaic tiles 8-bit as (32, 128)) and the group row
+widened to i32 in-register for the compare. The output block for a group
+slab is revisited across the N tiles (TPU grids run sequentially),
+accumulating in VMEM; step 0 zero-initializes.
 
 Counterpart of the CUDA shared-memory scatter kernels
 (src/treelearner/cuda/cuda_histogram_constructor.cu:20-513) — same
@@ -59,6 +62,23 @@ def _group_block(n_groups: int, n_channels: int, num_bins: int,
     return MIN_GROUP_BLOCK
 
 
+def _prep_bins(bins: jax.Array, n_channels: int, num_bins: int):
+    """Bin-plane dtype + group-block policy shared by the three wrappers.
+
+    8-bit planes (uint8 bins) pass through UNWIDENED — the dominant [G, N]
+    array moves 4x fewer HBM bytes — and the kernels widen each group row
+    to i32 in-register for the one-hot compare (Mosaic has no elementwise
+    8-bit vectors). Mosaic tiles 8-bit arrays as (32, 128), so the bins
+    block's group dim is pinned to 32; when the matching (32, SC, B) f32
+    output block would blow the VMEM budget, widen to int32 up front and
+    let _group_block pick a smaller block instead."""
+    if (bins.dtype.itemsize == 1
+            and 32 * n_channels * num_bins * 4 <= (4 << 20)):
+        return bins, 32
+    return bins.astype(jnp.int32), _group_block(
+        bins.shape[0], n_channels, num_bins)
+
+
 def _make_kernel(num_bins: int, tile_rows: int, compute_dtype, acc_dtype,
                  group_block: int):
     def kernel(bins_ref, gh_ref, out_ref):
@@ -69,7 +89,7 @@ def _make_kernel(num_bins: int, tile_rows: int, compute_dtype, acc_dtype,
         gh = gh_ref[...].astype(compute_dtype)
         iota = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, num_bins), 1)
         for gi in range(group_block):  # unrolled: static VMEM indices
-            b = bins_ref[gi, :]  # [TN] int32
+            b = bins_ref[gi, :].astype(jnp.int32)  # widen 8-bit in-register
             onehot = (b[:, None] == iota).astype(compute_dtype)  # VMEM only
             # [CH, B] orientation: B rides the 128-lane dim. The [B, CH]
             # orientation pads CH (2-6) up to 128 output lanes — a 20x+ FLOP
@@ -126,11 +146,10 @@ def pallas_histogram(bins: jax.Array, gh: jax.Array, num_bins: int,
         compute_dtype, acc_dtype = jnp.bfloat16, jnp.float32
     n_tiles = max(-(-N // tile_rows), 1)
     pad = n_tiles * tile_rows - N
-    bins = bins.astype(jnp.int32)
+    bins, GB = _prep_bins(bins, CH, num_bins)
     if pad:
         bins = jnp.pad(bins, ((0, 0), (0, pad)), constant_values=0)
         gh = jnp.pad(gh, ((0, pad), (0, 0)))  # zero gh => no contribution
-    GB = _group_block(G, CH, num_bins)
     g_blocks = max(-(-G // GB), 1)
     g_pad = g_blocks * GB - G
     if g_pad:  # padded groups accumulate into rows sliced off below
@@ -183,7 +202,7 @@ def _make_slots_kernel(num_bins: int, tile_rows: int, n_slots: int,
         ghK = (gsum * (colslot == s).astype(build_dtype)).astype(compute_dtype)
         iota = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, num_bins), 1)
         for gi in range(group_block):
-            b = bins_ref[gi, :]
+            b = bins_ref[gi, :].astype(jnp.int32)
             onehot = (b[:, None] == iota).astype(compute_dtype)
             acc = jax.lax.dot_general(
                 ghK, onehot,
@@ -225,13 +244,12 @@ def pallas_histogram_slots(bins: jax.Array, gh: jax.Array, slot: jax.Array,
         compute_dtype, acc_dtype = jnp.bfloat16, jnp.float32
     n_tiles = max(-(-N // tile_rows), 1)
     pad = n_tiles * tile_rows - N
-    bins = bins.astype(jnp.int32)
+    bins, GB = _prep_bins(bins, SC, num_bins)
     slot = slot.reshape(N, 1).astype(jnp.int32)
     if pad:
         bins = jnp.pad(bins, ((0, 0), (0, pad)), constant_values=0)
         gh = jnp.pad(gh, ((0, pad), (0, 0)))  # zero gh => no contribution
         slot = jnp.pad(slot, ((0, pad), (0, 0)), constant_values=n_slots)
-    GB = _group_block(G, SC, num_bins)
     g_blocks = max(-(-G // GB), 1)
     g_pad = g_blocks * GB - G
     if g_pad:
@@ -303,7 +321,7 @@ def _make_slots_ragged_kernel(num_bins: int, tile_rows: int, n_slots: int,
             iota = jax.lax.broadcasted_iota(jnp.int32,
                                             (tile_rows, num_bins), 1)
             for gi in range(group_block):
-                b = bins_ref[gi, :]
+                b = bins_ref[gi, :].astype(jnp.int32)
                 onehot = (b[:, None] == iota).astype(compute_dtype)
                 acc = jax.lax.dot_general(
                     ghK, onehot,
@@ -357,9 +375,8 @@ def pallas_histogram_slots_ragged(bins: jax.Array, gh: jax.Array,
     else:
         compute_dtype, acc_dtype = jnp.bfloat16, jnp.float32
     T = tiles.shape[0]
-    bins = bins.astype(jnp.int32)
+    bins, GB = _prep_bins(bins, SC, num_bins)
     slot = slot.reshape(N, 1).astype(jnp.int32)
-    GB = _group_block(G, SC, num_bins)
     g_blocks = max(-(-G // GB), 1)
     g_pad = g_blocks * GB - G
     if g_pad:

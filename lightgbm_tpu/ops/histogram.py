@@ -92,8 +92,10 @@ def build_histogram(bins: jax.Array, gh: jax.Array, num_bins: int,
     if use_pallas:
         from .hist_pallas import hist_force_f32, pallas_histogram
 
+        # native dtype pass-through: 8-bit planes stay narrow on the wire
+        # (the kernel wrapper widens only when its VMEM policy demands it)
         return pallas_histogram(
-            bins.astype(jnp.int32), gh, num_bins,
+            bins, gh, num_bins,
             quantized=jnp.issubdtype(jnp.dtype(compute_dtype), jnp.integer),
             f32=hist_force_f32())
     return _build_histogram_xla(bins, gh, num_bins, row_chunk, compute_dtype)
@@ -145,8 +147,7 @@ def build_histogram_rows(bins: jax.Array, gh_ext: jax.Array, row_idx: jax.Array,
         from .hist_pallas import hist_force_f32, pallas_histogram
 
         G, N = bins.shape
-        bins_leaf = jnp.take(bins, jnp.minimum(row_idx, N - 1),
-                             axis=1).astype(jnp.int32)
+        bins_leaf = jnp.take(bins, jnp.minimum(row_idx, N - 1), axis=1)
         gh_leaf = jnp.take(gh_ext, row_idx, axis=0)
         return pallas_histogram(
             bins_leaf, gh_leaf, num_bins,

@@ -116,9 +116,15 @@ def _decide_go_left(gb, thresh, default_left, missing_type, default_bin,
     return jnp.where(is_missing, default_left, fbin <= thresh)
 
 
+# bins/gh/leaf_id0 are donated: each is a fresh per-tree buffer (the
+# learner COPIES bins_dev before the call) consumed by the wave loop, so
+# XLA reuses their allocations for the loop carries instead of double
+# buffering the two largest arrays. CPU backends ignore donation (warning
+# suppressed by Python's default dedup filter).
 @partial(jax.jit,
          static_argnames=("num_leaves", "num_bins", "max_depth", "quantized",
-                          "batch", "bagged"))
+                          "batch", "bagged"),
+         donate_argnums=(0, 1, 2))
 def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
                         meta, tables: FeatureTables, params: jax.Array,
                         feature_mask: jax.Array,
@@ -174,8 +180,15 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         bins = jnp.pad(bins, ((0, 0), (0, Np - N)), constant_values=0)
         gh = jnp.pad(gh, ((0, Np - N), (0, 0)))
         leaf_id0 = jnp.pad(leaf_id0, (0, Np - N), constant_values=-1)
-    Gp = -(-G // 8) * 8  # Mosaic: second-to-last block dim multiple of 8
-    bins_p = bins.astype(jnp.int32)
+    # 8-bit planes (uint8 bins, every group <= 256 bins) are carried
+    # UNWIDENED through the wave loop — 4x less HBM traffic on the dominant
+    # [Gp, Np] array, single-limb compaction transport. Mosaic tiles 8-bit
+    # as (32, 128), so the group dim pads to 32 instead of 8. Wider planes
+    # (uint16 groups, or the LGBM_TPU_BINS_I32 escape hatch upstream)
+    # widen to int32 here as before.
+    plane8 = bins.dtype.itemsize == 1
+    Gp = -(-G // 32) * 32 if plane8 else -(-G // 8) * 8
+    bins_p = bins if plane8 else bins.astype(jnp.int32)
     if Gp != G:
         bins_p = jnp.pad(bins_p, ((0, Gp - G), (0, 0)), constant_values=0)
     T_hist = Np // DEFAULT_TILE_ROWS
@@ -473,6 +486,8 @@ class DevicePartition:
     def __init__(self, leaf_ids_dev: jax.Array, counts: Dict[int, int]) -> None:
         self._ids_dev = leaf_ids_dev
         self._ids: Optional[np.ndarray] = None
+        self._order: Optional[np.ndarray] = None
+        self._sorted: Optional[np.ndarray] = None
         self.counts = counts
 
     def leaf_ids_dev(self) -> jax.Array:
@@ -488,11 +503,44 @@ class DevicePartition:
         return self.counts.get(leaf, 0)
 
     def indices(self, leaf: int) -> np.ndarray:
-        return np.nonzero(self.ids_host == leaf)[0].astype(np.int32)
+        # one stable argsort amortized over every leaf query (the old
+        # per-leaf np.nonzero scan was O(N) PER LEAF under the serial
+        # fallbacks and quantized leaf renewal). Stable sort keeps equal
+        # ids in ascending position order, so each slice is bit-identical
+        # to the nonzero scan's output.
+        if self._order is None:
+            ids = self.ids_host
+            self._order = np.argsort(ids, kind="stable").astype(np.int32)
+            self._sorted = ids[self._order]
+        lo = np.searchsorted(self._sorted, leaf, side="left")
+        hi = np.searchsorted(self._sorted, leaf, side="right")
+        return self._order[lo:hi]
+
+
+class _PendingTree(NamedTuple):
+    """In-flight tree: dispatched on device, split log not yet replayed.
+
+    `tree` is the (still empty) host Tree that finalize() fills IN PLACE —
+    the async pipeline in models/gbdt.py appends it to the model list
+    before the replay happens, so predictions through the model see the
+    grown tree as soon as finalize() returns."""
+
+    tree: Tree
+    rec_store: jax.Array
+    leaf_id: jax.Array
+    hist_rows: jax.Array
+    n_bag: int
 
 
 class DeviceTreeLearner(SerialTreeLearner):
-    """Serial learner running the whole tree in one dispatch."""
+    """Serial learner running the whole tree in one dispatch.
+
+    train() splits into train_async() (dispatch + start the device->host
+    copy of the split log, non-blocking) and finalize() (block on the log,
+    replay it into the Tree, install the partition). The GBDT async
+    pipeline overlaps tree t's device growth with the host replay of tree
+    t-1 by holding the _PendingTree across iterations; the plain train()
+    path chains the two immediately and is bit-identical."""
 
     def __init__(self, config, dataset) -> None:
         super().__init__(config, dataset)
@@ -503,11 +551,30 @@ class DeviceTreeLearner(SerialTreeLearner):
         # deeper amortization, lower if speculation hit-rate drops.
         self.wave = int(os.environ.get("LGBM_TPU_WAVE", "21"))
 
+    def _record_carry_bytes(self) -> None:
+        """Gauge: HBM bytes of the per-wave loop carry (bin plane + row
+        payload) — the bandwidth model's dominant term (docs/PERF_NOTES.md).
+        """
+        from ..ops.compact_pallas import COMPACT_TILE
+        from ..ops.hist_pallas import DEFAULT_TILE_ROWS
+        unit = max(DEFAULT_TILE_ROWS, COMPACT_TILE)
+        np_rows = -(-self.num_data // unit) * unit
+        G = self.bins_dev.shape[0]
+        plane_b = self.bins_dev.dtype.itemsize
+        plane_b = plane_b if plane_b == 1 else 4
+        Gp = -(-G // 32) * 32 if plane_b == 1 else -(-G // 8) * 8
+        global_timer.set_count(
+            "device_carry_bytes_per_wave",
+            Gp * np_rows * plane_b + np_rows * 5 * 4)  # bins + [N, CH+2] f32
+
     def train(self, gh_ext: jax.Array,
               bag_indices: Optional[np.ndarray] = None) -> Tree:
+        return self.finalize(self.train_async(gh_ext, bag_indices))
+
+    def train_async(self, gh_ext: jax.Array,
+                    bag_indices: Optional[np.ndarray] = None) -> _PendingTree:
         cfg = self.config
         num_leaves = cfg.num_leaves
-        tree = Tree(num_leaves)
         if self.quantized:
             gh_ext = self._prepare_gh(gh_ext)  # int8 rows + scales
         gh = gh_ext[:-1]
@@ -517,27 +584,47 @@ class DeviceTreeLearner(SerialTreeLearner):
             leaf_id0 = jnp.asarray(np.where(in_bag, 0, -1), dtype=jnp.int32)
             gh = jnp.where(jnp.asarray(in_bag, dtype=jnp.bool_)[:, None], gh,
                            jnp.zeros((), gh.dtype))
+            n_bag = len(bag_indices)
         else:
             leaf_id0 = jnp.zeros(self.num_data, dtype=jnp.int32)
+            n_bag = self.num_data
 
         if self.col_sampler.active:
             fmask = jnp.asarray(self.col_sampler.reset_by_tree(),
                                 dtype=jnp.bool_)
         else:
             fmask = jnp.ones(len(self.meta.real_feature), dtype=bool)
+        self._record_carry_bytes()
         with global_timer.scope("tree_device"):
+            # bins_dev is COPIED per tree: grow_tree_on_device donates its
+            # first three args (gh and leaf_id0 are already fresh buffers)
             rec_store, leaf_id, _, hist_rows = grow_tree_on_device(
-                self.bins_dev, gh, leaf_id0, self.meta, self.tables,
-                self.params_dev, fmask, num_leaves, self.group_bin_padded,
+                jnp.copy(self.bins_dev), gh, leaf_id0, self.meta,
+                self.tables, self.params_dev, fmask, num_leaves,
+                self.group_bin_padded,
                 cfg.max_depth, quantized=self.quantized,
                 scale_vec=self._scale_vec, batch=self.wave,
                 bagged=bag_indices is not None)
-            rec_np = np.asarray(rec_store)  # the one transfer per tree
-        self.last_hist_rows = int(hist_rows)
+        # start the device->host copies without blocking; finalize() (maybe
+        # a full iteration later, under the async pipeline) pays no wait if
+        # the transfer already landed
+        for arr in (rec_store, leaf_id, hist_rows):
+            start = getattr(arr, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        return _PendingTree(Tree(num_leaves), rec_store, leaf_id, hist_rows,
+                            n_bag)
+
+    def finalize(self, pending: _PendingTree) -> Tree:
+        cfg = self.config
+        tree = pending.tree
+        with global_timer.scope("tree_replay"):
+            rec_np = np.asarray(pending.rec_store)  # the one blocking pull
+        leaf_id = pending.leaf_id
+        self.last_hist_rows = int(pending.hist_rows)
         global_timer.add_count("device_hist_rows", self.last_hist_rows)
 
-        counts: Dict[int, int] = {0: int(self.num_data if bag_indices is None
-                                         else len(bag_indices))}
+        counts: Dict[int, int] = {0: int(pending.n_bag)}
         for t in range(rec_np.shape[0]):
             row = rec_np[t]
             if row[3] < 0.5:  # valid flag: growth stopped here

@@ -18,6 +18,7 @@ by the frontier map; LRU capping arrives with histogram_pool_size support).
 """
 from __future__ import annotations
 
+import os
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
@@ -178,6 +179,15 @@ class SerialTreeLearner:
     # these hooks; the leaf-wise control flow above is shared.
 
     def _device_bins(self, dataset: Dataset) -> jax.Array:
+        """Upload the bin matrix at its native width. uint8 planes (every
+        group <= 256 bins, the common case) stay 8-bit end to end — the
+        device learner carries and histograms them unwidened. The int32
+        escape hatch: LGBM_TPU_BINS_I32=1 forces a wide plane; datasets
+        with any group > 256 bins are uint16 host-side already and widen
+        automatically downstream."""
+        if (dataset.bins.dtype.itemsize == 1
+                and os.environ.get("LGBM_TPU_BINS_I32", "") == "1"):
+            return jnp.asarray(dataset.bins, dtype=jnp.int32)
         return jnp.asarray(dataset.bins, dtype=dataset.bins.dtype)
 
     def _prepare_gh(self, gh_ext: jax.Array) -> jax.Array:

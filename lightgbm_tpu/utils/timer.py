@@ -48,6 +48,12 @@ class GlobalTimer:
         """
         self.counters[label] += int(n)
 
+    def set_count(self, label: str, n: int) -> None:
+        """Set a gauge counter (a level, not an accumulation): idempotent,
+        so per-tree code can re-publish a static figure — e.g. the device
+        learner's `device_carry_bytes_per_wave` — without inflating it."""
+        self.counters[label] = int(n)
+
     def report(self) -> str:
         lines = ["LightGBM-TPU timer summary:"]
         for label in sorted(self.totals, key=self.totals.get, reverse=True):

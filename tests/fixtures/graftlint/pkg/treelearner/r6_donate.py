@@ -1,0 +1,30 @@
+"""R6 fixture: jitted entry points with device-array params must donate."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit  # line 8: VIOLATION jit-donation (anchored at the decorator)
+def undonated(bins: jax.Array, gh: jax.Array):
+    return bins.sum() + gh.sum()
+
+
+@partial(jax.jit, static_argnames=("n",), donate_argnums=(0,))
+def donated(bins: jax.Array, n: int):  # donate_argnums declared: clean
+    return bins.sum() + n
+
+
+# graftlint: disable=jit-donation -- fixture: bins reused across iterations
+@jax.jit
+def suppressed(bins: "jax.Array"):
+    return bins.sum()
+
+
+@jax.jit
+def scalar_only(n: int, scale: float):  # no array params: exempt
+    return n * scale
+
+
+def not_jitted(bins: jax.Array):  # no jit decorator: exempt
+    return jnp.sum(bins)

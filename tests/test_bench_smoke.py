@@ -34,3 +34,13 @@ def test_bench_smoke_cpu():
     assert record["value"] > 0
     assert record["rows"] == 20000
     assert 0.5 <= record["auc"] <= 1.0
+    # wave-traffic instrumentation: both fields present on EVERY record
+    # (CPU benches run the serial learner, so the row counter may be 0 but
+    # the carry estimate still comes from the dataset shape formula)
+    assert record["device_hist_rows"] >= 0
+    assert record["est_carried_bytes_per_wave"] > 0
+    # 28 features -> Gp=32 groups; rows pad to the 1024-row wave unit.
+    # uint8 plane: carry = np_rows * (32*1 + 20); the int32 figure would be
+    # np_rows * (32*4 + 20) — assert we sit in the narrow-plane regime.
+    n_pad = -(-20000 // 1024) * 1024
+    assert record["est_carried_bytes_per_wave"] == n_pad * (32 + 20)

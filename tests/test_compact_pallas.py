@@ -81,6 +81,50 @@ def test_compact_pallas_bit_exact(rng, name, ranges, tile):
         np.asarray(ours_r).view(np.uint32), ref_r.view(np.uint32))
 
 
+@pytest.mark.parametrize("name,ranges", CASES)
+def test_compact_pallas_uint8_plane(rng, name, ranges):
+    """8-bit bin plane rides the single-limb path, output stays uint8 and
+    matches both the permutation oracle and the int32 2-limb result."""
+    n, gp, rc, tile = 2048, 32, 5, 256  # gp % 32 == 0 for the 8-bit tile
+    go_left = rng.rand(n) < 0.5
+    dst, _, cm, match = _dst(go_left, ranges, n)
+    bins8 = rng.randint(0, 256, size=(gp, n)).astype(np.uint8)
+    row = rng.randn(n, rc).astype(np.float32)
+    moved = match.any(axis=1)
+    args = ([jnp.asarray(m) for m in cm], jnp.asarray(moved))
+    b8, r8 = compact_rows(
+        jnp.asarray(bins8), jnp.asarray(row), jnp.asarray(dst), *args,
+        tile=tile, use_pallas=True, interpret=True)
+    assert np.asarray(b8).dtype == np.uint8
+    ref_b = np.zeros_like(bins8)
+    ref_b[:, dst] = bins8
+    np.testing.assert_array_equal(np.asarray(b8), ref_b)
+    b32, r32 = compact_rows(
+        jnp.asarray(bins8.astype(np.int32)), jnp.asarray(row),
+        jnp.asarray(dst), *args, tile=tile, use_pallas=True, interpret=True)
+    np.testing.assert_array_equal(np.asarray(b8).astype(np.int32),
+                                  np.asarray(b32))
+    np.testing.assert_array_equal(
+        np.asarray(r8).view(np.uint32), np.asarray(r32).view(np.uint32))
+
+
+def test_compact_xla_fallback_uint8(rng):
+    n, gp = 1024, 4
+    ranges = [(100, 500)]
+    go_left = rng.rand(n) < 0.3
+    dst, _, cm, match = _dst(go_left, ranges, n)
+    bins = rng.randint(0, 256, size=(gp, n)).astype(np.uint8)
+    row = rng.randn(n, 3).astype(np.float32)
+    ours_b, _ = compact_rows(
+        jnp.asarray(bins), jnp.asarray(row), jnp.asarray(dst),
+        [jnp.asarray(m) for m in cm], jnp.asarray(match.any(axis=1)),
+        use_pallas=False)
+    assert np.asarray(ours_b).dtype == np.uint8
+    ref_b = np.zeros_like(bins)
+    ref_b[:, dst] = bins
+    np.testing.assert_array_equal(np.asarray(ours_b), ref_b)
+
+
 def test_compact_xla_fallback_exact(rng):
     n, gp, rc = 1024, 3, 5
     ranges = [(100, 500), (700, 300)]
@@ -137,10 +181,19 @@ def test_pair_table_bound_and_coverage(rng):
     live = np.asarray(po)[:int(npairs[0])]
     assert set(live.tolist()) == set(range(t))
     assert (np.diff(live) >= 0).all()
-    # untouched tiles flagged as raw copies
+    # pcopy semantics: 1 = raw copy of an untouched identity tile,
+    # 2 = duplicate pair demoted to a skip (must repeat its predecessor's
+    # blocks and never open an output block), 0 = one-hot permute.
     touched = match.any(axis=1).reshape(t, tile).any(axis=1)
     live_in = np.asarray(pi)[:int(npairs[0])]
     live_copy = np.asarray(copy)[:int(npairs[0])]
     for p in range(int(npairs[0])):
-        if live_copy[p]:
+        if live_copy[p] == 1:
             assert live_in[p] == live[p] and not touched[live_in[p]]
+        elif live_copy[p] == 2:
+            assert p > 0
+            assert live_in[p] == live_in[p - 1] and live[p] == live[p - 1]
+    # after dropping skip pairs, (in, out) pairs are unique
+    keep = live_copy < 2
+    pairs = list(zip(live_in[keep].tolist(), live[keep].tolist()))
+    assert len(pairs) == len(set(pairs))

@@ -141,6 +141,143 @@ def test_device_pallas_interpret_matches_serial(rng, monkeypatch):
         device_mod.grow_tree_on_device.clear_cache()
 
 
+def _device_booster(X, y, params, n_iters, probe=None):
+    cfg = Config(params)
+    ds = CoreDataset.from_matrix(X, label=y, config=cfg)
+    obj = create_objective(cfg.objective, cfg)
+    bst = GBDT(cfg, ds, obj)
+    bst.tree_learner = DeviceTreeLearner(cfg, ds)
+    stopped_at = None
+    for it in range(n_iters):
+        if bst.train_one_iter():
+            stopped_at = it
+            break
+        if probe is not None:
+            probe(bst, it)
+    bst.to_model()  # flushes any in-flight async tree
+    return bst, stopped_at
+
+
+def _assert_same_models(a, b):
+    assert len(a.models) == len(b.models)
+    for ta, tb in zip(a.models, b.models):
+        for k, va in ta.__dict__.items():
+            vb = tb.__dict__[k]
+            if isinstance(va, np.ndarray):
+                np.testing.assert_array_equal(va, vb, err_msg=k)
+            else:
+                assert va == vb, k
+
+
+def test_async_pipeline_bit_identical(rng, monkeypatch):
+    """The async per-tree pipeline (device growth of tree t overlapped with
+    host replay of t-1, score updated from the device split log) must be
+    BIT-identical to the sync path, not merely close."""
+    X = rng.randn(900, 8)
+    y = (X[:, 0] - 0.7 * X[:, 1] + rng.randn(900) * 0.3 > 0).astype(float)
+    # 0.5 is f32-exact, so device f32 (leaf * rate) == host f64-shrink + cast
+    params = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.5,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    monkeypatch.setenv("LGBM_TPU_ASYNC", "0")
+    sync, _ = _device_booster(X, y, params, 6)
+    monkeypatch.setenv("LGBM_TPU_ASYNC", "1")
+    # mid-stream predict forces a flush while a tree is in flight
+    asy, _ = _device_booster(
+        X, y, params, 6,
+        probe=lambda b, it: b.predict(X[:64], raw_score=True) if it == 2 else None)
+    _assert_same_models(sync, asy)
+    np.testing.assert_array_equal(np.asarray(sync.score[0]),
+                                  np.asarray(asy.score[0]))
+    np.testing.assert_array_equal(
+        np.asarray(sync.predict(X, raw_score=True)),
+        np.asarray(asy.predict(X, raw_score=True)))
+
+
+def test_async_auto_gate(rng, monkeypatch):
+    """Without LGBM_TPU_ASYNC the pipeline self-enables only when the
+    learning rate is exactly representable in f32 (bit-identity proof
+    holds); 0.1 is not f32-exact so it must stay sync."""
+    monkeypatch.delenv("LGBM_TPU_ASYNC", raising=False)
+    X = rng.randn(200, 4)
+    y = (X[:, 0] > 0).astype(float)
+    for rate, want in ((0.5, True), (0.1, False)):
+        cfg = Config({"objective": "binary", "num_leaves": 7,
+                      "learning_rate": rate, "verbosity": -1})
+        ds = CoreDataset.from_matrix(X, label=y, config=cfg)
+        bst = GBDT(cfg, ds, create_objective("binary", cfg))
+        bst.tree_learner = DeviceTreeLearner(cfg, ds)
+        assert bst._async_enabled() is want, rate
+        monkeypatch.setenv("LGBM_TPU_ASYNC", "0")
+        assert bst._async_enabled() is False
+        monkeypatch.delenv("LGBM_TPU_ASYNC", raising=False)
+
+
+def test_async_stops_on_no_gain(rng, monkeypatch):
+    """A no-split tree is discovered one iteration late in the pipeline
+    (at flush); the stub and its zero-delta duplicate are both unwound so
+    the surviving model list matches the sync run exactly."""
+    monkeypatch.setenv("LGBM_TPU_ASYNC", "0")
+    X = rng.randn(400, 4)
+    y = np.ones(400)
+    params = {"objective": "regression", "num_leaves": 31,
+              "learning_rate": 0.5, "boost_from_average": False,
+              "verbosity": -1}
+    sync, stop_sync = _device_booster(X, y, params, 6)
+    monkeypatch.setenv("LGBM_TPU_ASYNC", "1")
+    asy, stop_async = _device_booster(X, y, params, 6)
+    assert stop_sync is not None and stop_async is not None
+    # the pipeline may report the stop at most one iteration later
+    assert stop_async <= stop_sync + 1
+    _assert_same_models(sync, asy)
+    assert sync.iter_ == asy.iter_
+
+
+_PLANE_VARIANTS = {
+    "plain": {},
+    "bagged": {"bagging_fraction": 0.7, "bagging_freq": 1, "seed": 7},
+    "quantized": {"use_quantized_grad": True, "quant_train_renew_leaf": True},
+}
+
+
+@pytest.mark.parametrize("variant,interpret", [
+    ("plain", False), ("bagged", False), ("quantized", False),
+    ("plain", True), ("quantized", True),
+])
+def test_device_uint8_vs_i32_bit_identical(rng, monkeypatch, variant,
+                                           interpret):
+    """The narrow uint8 bin plane is a pure transport change: forcing the
+    int32 escape hatch (LGBM_TPU_BINS_I32=1) must reproduce the same trees,
+    predictions and hist-rows counter BIT for bit — on the XLA fallback and
+    through the Pallas kernels in interpret mode."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.treelearner import device as device_mod
+
+    if interpret:
+        monkeypatch.setenv("LGBM_TPU_PALLAS_INTERPRET", "1")
+    device_mod.grow_tree_on_device.clear_cache()
+    try:
+        n = 600 if interpret else 1000
+        n_iters = 2 if interpret else 4
+        X = rng.randn(n, 6)
+        y = (X[:, 0] - 0.6 * X[:, 1] + rng.randn(n) * 0.3 > 0).astype(float)
+        params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+                  **_PLANE_VARIANTS[variant]}
+        monkeypatch.delenv("LGBM_TPU_BINS_I32", raising=False)
+        b8, _ = _device_booster(X, y, params, n_iters)
+        assert b8.tree_learner.bins_dev.dtype == jnp.uint8
+        rows8 = b8.tree_learner.last_hist_rows
+        monkeypatch.setenv("LGBM_TPU_BINS_I32", "1")
+        b32, _ = _device_booster(X, y, params, n_iters)
+        assert b32.tree_learner.bins_dev.dtype == jnp.int32
+        _assert_same_models(b8, b32)
+        np.testing.assert_array_equal(
+            np.asarray(b8.predict(X, raw_score=True)),
+            np.asarray(b32.predict(X, raw_score=True)))
+        assert rows8 == b32.tree_learner.last_hist_rows
+    finally:
+        device_mod.grow_tree_on_device.clear_cache()
+
+
 def test_device_learner_quantized_matches_serial_quantized(rng):
     """Quantized int8/int32 path in the fori_loop learner: identical int
     gradients (same PRNG seed + call order) must reproduce the serial

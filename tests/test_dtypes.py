@@ -58,3 +58,46 @@ def test_packed_ensemble_dtypes():
 def test_partition_index_dtypes():
     part = RowPartition(1000, min_bucket=256)
     assert part.indices(0).dtype == jnp.int32
+
+
+# -- the 8-bit bin-plane ABI ------------------------------------------------
+# The device learner carries the [G, N] bin plane UNWIDENED through the wave
+# loop (4x less HBM traffic than int32); kernels widen per tile in-register.
+# These locks keep a stray astype from silently restoring the wide plane.
+
+def test_dataset_bins_host_dtype_uint8(small_ds):
+    # max_bin <= 256: one byte per (group, row) on the host side too
+    assert small_ds.bins.dtype == np.uint8
+
+
+def test_device_learner_bins_stay_uint8(small_ds):
+    from lightgbm_tpu.treelearner.device import DeviceTreeLearner
+
+    learner = DeviceTreeLearner(Config({"verbosity": -1}), small_ds)
+    assert learner.bins_dev.dtype == jnp.uint8
+
+
+def test_bins_i32_escape_hatch(small_ds, monkeypatch):
+    # LGBM_TPU_BINS_I32=1 restores the pre-narrowing int32 plane (debug /
+    # backend-regression escape hatch; results stay bit-identical — see
+    # test_device_learner.py::test_device_uint8_vs_i32_bit_identical)
+    from lightgbm_tpu.treelearner.device import DeviceTreeLearner
+
+    monkeypatch.setenv("LGBM_TPU_BINS_I32", "1")
+    learner = DeviceTreeLearner(Config({"verbosity": -1}), small_ds)
+    assert learner.bins_dev.dtype == jnp.int32
+
+
+def test_wide_bins_auto_widen():
+    # > 256 bins cannot fit a byte: the host plane is uint16 and the device
+    # path widens to int32 at the kernel boundary automatically
+    from lightgbm_tpu.treelearner.device import DeviceTreeLearner
+
+    rng = np.random.RandomState(5)
+    X = rng.normal(size=(2000, 2))
+    y = rng.normal(size=2000).astype(np.float32)
+    cfg = Config({"max_bin": 500, "verbosity": -1})
+    ds = CoreDS.from_matrix(X, label=y, config=cfg)
+    assert ds.bins.dtype == np.uint16
+    learner = DeviceTreeLearner(cfg, ds)
+    assert learner.bins_dev.dtype.itemsize > 1

@@ -187,6 +187,58 @@ def test_active_tile_table():
     np.testing.assert_array_equal(np.asarray(tiles), [0, 1, 2, 2])
 
 
+def test_pallas_histogram_uint8_bins_bit_identical(rng):
+    """The 8-bit plane path (uint8 bins pass through unwidened, kernel
+    widens the group row in-register) is bit-identical to int32 bins."""
+    G, B, n = 5, 256, 3000
+    bins8 = rng.randint(0, B, size=(G, n)).astype(np.uint8)
+    gh = rng.randn(n, 3).astype(np.float32)
+    for f32 in (True, False):
+        ours8 = np.asarray(pallas_histogram(
+            jnp.asarray(bins8), jnp.asarray(gh), B, f32=f32, interpret=True))
+        ours32 = np.asarray(pallas_histogram(
+            jnp.asarray(bins8.astype(np.int32)), jnp.asarray(gh), B,
+            f32=f32, interpret=True))
+        np.testing.assert_array_equal(ours8.view(np.uint32),
+                                      ours32.view(np.uint32))
+
+
+def test_pallas_histogram_slots_ragged_uint8_bit_identical(rng):
+    """Wave (ragged) kernel: uint8 bins bit-identical to int32 bins, float
+    and quantized variants."""
+    from lightgbm_tpu.ops.hist_pallas import pallas_histogram_slots_ragged
+
+    n, tile, S = 4096, 512, 3
+    ranges = [(0, 900), (1500, 2600), (3000, 4000)]
+    for quant in (False, True):
+        G, B, bins, gh, slot, tiles, n_act = _ragged_setup(
+            rng, n, tile, ranges, S, quantized=quant)
+        bins8 = bins.astype(np.uint8)
+        a = np.asarray(pallas_histogram_slots_ragged(
+            jnp.asarray(bins8), jnp.asarray(gh), jnp.asarray(slot), tiles,
+            n_act, B, S, tile_rows=tile, quantized=quant, interpret=True))
+        b = np.asarray(pallas_histogram_slots_ragged(
+            jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(slot), tiles,
+            n_act, B, S, tile_rows=tile, quantized=quant, interpret=True))
+        np.testing.assert_array_equal(a.view(np.uint32), b.view(np.uint32))
+
+
+def test_pallas_histogram_slots_uint8_bit_identical(rng):
+    from lightgbm_tpu.ops.hist_pallas import pallas_histogram_slots
+
+    G, B, n, S = 3, 16, 3000, 4
+    bins8 = rng.randint(0, B, size=(G, n)).astype(np.uint8)
+    gh = rng.randn(n, 3).astype(np.float32)
+    slot = rng.randint(0, S + 2, size=n).astype(np.int32)
+    a = np.asarray(pallas_histogram_slots(
+        jnp.asarray(bins8), jnp.asarray(gh), jnp.asarray(slot), B, S,
+        interpret=True))
+    b = np.asarray(pallas_histogram_slots(
+        jnp.asarray(bins8.astype(np.int32)), jnp.asarray(gh),
+        jnp.asarray(slot), B, S, interpret=True))
+    np.testing.assert_array_equal(a.view(np.uint32), b.view(np.uint32))
+
+
 def test_pallas_histogram_quantized_exact(rng):
     G, B, n = 4, 32, 5000
     bins = rng.randint(0, B, size=(G, n)).astype(np.int32)

@@ -133,6 +133,27 @@ def test_r5_suppression_honored(fixture_result):
     assert len(sup) == 1 and "'big_suppressed'" in sup[0].message
 
 
+# -- R6 donation discipline -----------------------------------------------
+
+def test_r6_undonated_jit_entry_detected(fixture_result):
+    bad = _hits(fixture_result, "jit-donation", "treelearner/r6_donate.py")
+    assert len(bad) == 1 and "'undonated'" in bad[0].message
+    assert bad[0].line == 8  # anchored at the decorator, not the def
+
+
+def test_r6_donated_scalar_and_unjitted_are_clean(fixture_result):
+    msgs = [v.message for v in
+            fixture_result.violations + fixture_result.suppressed]
+    for name in ("'donated'", "'scalar_only'", "'not_jitted'"):
+        assert not any(name in m and "donate" in m for m in msgs), name
+
+
+def test_r6_suppression_honored(fixture_result):
+    sup = _hits(fixture_result, "jit-donation", suppressed=True)
+    assert len(sup) == 1 and "'suppressed'" in sup[0].message
+    assert "reused across iterations" in sup[0].reason
+
+
 # -- S1 directive hygiene -------------------------------------------------
 
 def test_s1_bad_directives_are_findings(fixture_result):
@@ -170,7 +191,8 @@ def test_ignore_filters_rules():
 
 def test_rule_codes_cover_names_and_codes():
     table = rule_codes()
-    for ident in ("R1", "R2", "R3", "R4", "R5", "jit-host-sync",
+    for ident in ("R1", "R2", "R3", "R4", "R5", "R6", "jit-donation",
+                  "jit-host-sync",
                   "implicit-dtype", "pallas-tile-shape",
                   "pallas-prefetch-arity", "pallas-host-op",
                   "param-unread", "untimed-hot-func"):

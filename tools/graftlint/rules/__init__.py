@@ -7,6 +7,7 @@ from __future__ import annotations
 from typing import Dict, List
 
 from .base import Rule
+from .donation import DonationRule
 from .dtype_discipline import DtypeDisciplineRule
 from .jit_boundary import JitBoundaryRule
 from .pallas_rules import PallasRule
@@ -19,6 +20,7 @@ RULES: List[Rule] = [
     PallasRule(),
     ParamConsistencyRule(),
     TimerDisciplineRule(),
+    DonationRule(),
 ]
 
 # rule name -> R-code for ids emitted by rules beyond their primary name

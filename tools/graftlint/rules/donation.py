@@ -1,0 +1,97 @@
+"""R6 jit-donation: jitted entry points that take device arrays must donate.
+
+A `jax.jit` boundary in the training loop that accepts large device arrays
+without `donate_argnums` forces XLA to keep the caller's buffers alive
+across the call — the [G, N] bin plane and [N, CH] gh payload get DOUBLE
+buffered in HBM every tree. Donation lets XLA reuse the input allocations
+for outputs/loop carries; on a 10.5M-row HIGGS-shape dataset that is
+hundreds of MB of working set per dispatch (docs/PERF_NOTES.md).
+
+Scope: treelearner/ and models/ — the per-iteration training surface where
+the arrays are big and the calls are hot. ops/ kernels are exempt: they are
+called from already-jitted code (donation only applies at the outermost jit
+boundary). The rule is annotation-driven: a decorator-jitted function with
+at least one parameter annotated `jax.Array` / `jnp.ndarray` must either
+declare `donate_argnums`/`donate_argnames` or carry a reasoned suppression
+explaining why its inputs must outlive the call (e.g. a buffer reused
+across iterations on the caller's side).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Package, Violation, dotted_name
+from .base import Rule, module_functions
+from .jit_boundary import _is_jitted
+
+_ARRAY_ANNOTATIONS = {"jax.Array", "jnp.ndarray", "jax.numpy.ndarray",
+                      "np.ndarray", "numpy.ndarray"}
+
+
+def _annotation_names(node: ast.AST) -> Iterable[str]:
+    """Dotted names mentioned anywhere in an annotation expression,
+    including inside string ('jax.Array') and Optional[...] forms."""
+    for sub in ast.walk(node):
+        name = dotted_name(sub)
+        if name:
+            yield name
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            # deferred/string annotation: parse its text best-effort
+            try:
+                inner = ast.parse(sub.value, mode="eval")
+            except SyntaxError:
+                continue
+            for s in ast.walk(inner):
+                n = dotted_name(s)
+                if n:
+                    yield n
+
+
+def _has_array_param(fn: ast.AST) -> bool:
+    args = fn.args
+    params = list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+    for p in params:
+        if p.annotation is None:
+            continue
+        if any(n in _ARRAY_ANNOTATIONS for n in _annotation_names(p.annotation)):
+            return True
+    return False
+
+
+def _declares_donation(fn: ast.AST) -> bool:
+    for dec in fn.decorator_list:
+        for node in ast.walk(dec):
+            if isinstance(node, ast.keyword) and node.arg in (
+                    "donate_argnums", "donate_argnames"):
+                return True
+    return False
+
+
+class DonationRule(Rule):
+    name = "jit-donation"
+    code = "R6"
+    description = ("decorator-jitted function with jax.Array parameters "
+                   "declares no donate_argnums (inputs get double buffered)")
+    scope_prefixes = ("treelearner/", "models/")
+
+    def check(self, pkg: Package) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for ctx in self.scoped(pkg):
+            for qual, fn in module_functions(ctx.tree):
+                if not _is_jitted(fn):
+                    continue
+                if not _has_array_param(fn):
+                    continue
+                if _declares_donation(fn):
+                    continue
+                # anchor at the first decorator so a standalone suppression
+                # directly above @jax.jit covers the finding
+                anchor = fn.decorator_list[0] if fn.decorator_list else fn
+                out.append(self.violation(
+                    ctx, anchor,
+                    "jitted %r takes device-array args but declares no "
+                    "donate_argnums — caller buffers stay live across the "
+                    "call (double buffering); donate, or suppress with the "
+                    "reason the inputs must survive" % qual))
+        return out
