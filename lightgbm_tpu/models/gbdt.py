@@ -75,6 +75,18 @@ def _apply_split_log_to_score(score: jax.Array, rec_store: jax.Array,
         leaf_ids >= 0, lv[jnp.clip(leaf_ids, 0, L - 1)], 0.0)
 
 
+def _colocate(arr: jax.Array, ref: jax.Array) -> jax.Array:
+    """Move `arr` onto `ref`'s device when the two live on different device
+    sets. The mesh-sharded tree learner hands back outputs spanning the whole
+    mesh while the score vector lives on one device; jit refuses to mix the
+    two. device_put here is an async transfer — it overlaps the host replay
+    just like the copy_to_host_async pulls."""
+    if (isinstance(arr, jax.Array) and isinstance(ref, jax.Array)
+            and arr.sharding.device_set != ref.sharding.device_set):
+        return jax.device_put(arr, next(iter(ref.sharding.device_set)))
+    return arr
+
+
 class _ValidData:
     """Holds one validation set's device raw matrix, metadata, score."""
 
@@ -377,7 +389,8 @@ class GBDT:
             pending = self.tree_learner.train_async(gh_ext, None)
         with global_timer.scope("update_score"):
             self.score = self.score.at[0].set(_apply_split_log_to_score(
-                self.score[0], pending.rec_store, pending.leaf_id,
+                self.score[0], _colocate(pending.rec_store, self.score),
+                _colocate(pending.leaf_id, self.score),
                 jnp.float32(self.shrinkage_rate), self.config.num_leaves))
         self.models.append(pending.tree)
         self._packed_cache = None
@@ -462,7 +475,7 @@ class GBDT:
         if ids_fn is not None:
             # vectorized path: one gather over the device leaf-id vector
             # (bagged-out rows carry -1 and contribute nothing)
-            ids = ids_fn()
+            ids = _colocate(ids_fn(), score)
             lv = jnp.asarray(tree.leaf_value[: tree.num_leaves],
                              dtype=jnp.float32)
             score = score + jnp.where(

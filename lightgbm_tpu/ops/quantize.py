@@ -31,6 +31,16 @@ import jax
 import jax.numpy as jnp
 
 
+def int16_reduction_safe(row_count: int, num_grad_quant_bins: int) -> bool:
+    """True when a quantized histogram bin over `row_count` rows provably
+    fits int16, so the cross-device reduction can ship int16 instead of
+    int32 (the reference's int16 histogram reduction,
+    data_parallel_tree_learner.cpp:285-297). Conservative: assumes every
+    row lands in one bin at the max quantized magnitude, with headroom
+    under 2^15."""
+    return row_count * num_grad_quant_bins < 32000
+
+
 @partial(jax.jit, static_argnames=("num_bins", "stochastic"))
 def discretize_gradients(grad: jax.Array, hess: jax.Array, key: jax.Array,
                          num_bins: int = 4, stochastic: bool = True

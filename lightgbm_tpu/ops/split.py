@@ -140,7 +140,9 @@ def make_feature_meta(dataset, group_bin_padded: int) -> FeatureMeta:
 
 class ScanMeta(NamedTuple):
     """The FeatureMeta subset the split scan reads — a plain pytree so
-    distributed learners can shard it along the feature axis."""
+    distributed learners can shard it along the feature axis. efb_omitted
+    rides along so sharded learners can run fix_feature_hist on their local
+    feature block AFTER the cross-shard histogram reduction."""
 
     valid_slot: jax.Array  # [F, Bmax] bool
     default_bin: jax.Array  # [F] int32
@@ -148,11 +150,13 @@ class ScanMeta(NamedTuple):
     nbins: jax.Array  # [F] int32
     is_categorical: jax.Array  # [F] bool
     monotone: jax.Array  # [F] int32 (-1/0/+1)
+    efb_omitted: jax.Array  # [F] bool
 
 
 def scan_meta_of(meta: FeatureMeta) -> ScanMeta:
     return ScanMeta(meta.valid_slot, meta.default_bin, meta.missing_type,
-                    meta.nbins, meta.is_categorical, meta.monotone)
+                    meta.nbins, meta.is_categorical, meta.monotone,
+                    meta.efb_omitted)
 
 
 def pad_feature_meta(meta: FeatureMeta, f_pad: int) -> FeatureMeta:
@@ -251,22 +255,45 @@ class SplitInfo:
         return out
 
 
+def gather_feature_hist_raw(hist: jax.Array, gather_index: jax.Array,
+                            valid_slot: jax.Array) -> jax.Array:
+    """[G, Bg, CH] group hist -> [F, Bmax, CH] by pure index gather, NO EFB
+    reconstruction. Selection commutes bit-exactly with sum reductions
+    (integer or float, any summation order), so sharded learners gather
+    their raw local histograms, reduce across shards, and apply
+    fix_feature_hist on the reduced blocks with GLOBAL totals — matching
+    the single-device op order exactly."""
+    flat = hist.reshape(-1, hist.shape[-1])
+    flat = jnp.concatenate(
+        [flat, jnp.zeros((1, hist.shape[-1]), flat.dtype)], axis=0)
+    fh = flat[gather_index]  # [F, Bmax, CH]
+    return fh * valid_slot[:, :, None]
+
+
+def fix_feature_hist(fh: jax.Array, totals: jax.Array,
+                     efb_omitted: jax.Array,
+                     default_bin: jax.Array) -> jax.Array:
+    """EFB default-bin reconstruction: default = leaf totals - sum(other
+    bins), added at the default bin of bundle members only (FixHistogram,
+    include/LightGBM/dataset.h:770). Works on the full [F, Bmax, CH] tensor
+    or a sharded feature block — totals must be the LEAF totals matching
+    fh's aggregation scope.
+
+    (dtype-preserving multiply, not jnp.where with a float 0: quantized
+    histograms flow through here as exact int32)"""
+    missing_mass = totals[None, :].astype(fh.dtype) - fh.sum(axis=1)  # [F, CH]
+    add = missing_mass * efb_omitted[:, None]
+    return fh.at[jnp.arange(fh.shape[0], dtype=jnp.int32),
+                 default_bin].add(add)
+
+
 @partial(jax.jit, static_argnames=())
 def gather_feature_hist(hist: jax.Array, meta: FeatureMeta,
                         totals: jax.Array) -> jax.Array:
     """[G, Bg, 3] group hist -> [F, Bmax, 3] feature hist with EFB default
     reconstruction (FixHistogram)."""
-    flat = hist.reshape(-1, hist.shape[-1])
-    flat = jnp.concatenate([flat, jnp.zeros((1, hist.shape[-1]), flat.dtype)], axis=0)
-    fh = flat[meta.gather_index]  # [F, Bmax, 3]
-    fh = fh * meta.valid_slot[:, :, None]
-    # EFB default-bin reconstruction: default = leaf totals - sum(other bins)
-    # (dtype-preserving multiply, not jnp.where with a float 0: quantized
-    # histograms flow through here as exact int32)
-    missing_mass = totals[None, :].astype(fh.dtype) - fh.sum(axis=1)  # [F, 3]
-    add = missing_mass * meta.efb_omitted[:, None]
-    fh = fh.at[jnp.arange(fh.shape[0], dtype=jnp.int32), meta.default_bin].add(add)
-    return fh
+    fh = gather_feature_hist_raw(hist, meta.gather_index, meta.valid_slot)
+    return fix_feature_hist(fh, totals, meta.efb_omitted, meta.default_bin)
 
 
 def per_feature_best(fh: jax.Array, totals: jax.Array, meta: FeatureMeta,

@@ -138,6 +138,11 @@ def put_global_tree(tree, mesh: jax.sharding.Mesh, spec):
     return jax.tree_util.tree_map(lambda a: put_global(a, mesh, spec), tree)
 
 
+def put_replicated(tree, mesh: jax.sharding.Mesh):
+    """Replicate a pytree of host/device arrays onto every mesh device."""
+    return put_global_tree(tree, mesh, jax.sharding.PartitionSpec())
+
+
 def host_value(arr) -> np.ndarray:
     """Read a (possibly replicated multi-process) device array on host.
     Replicated out_specs=P() results are not fully addressable across

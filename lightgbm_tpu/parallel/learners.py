@@ -25,6 +25,7 @@ gather), decoupling comm volume from the feature count.
 """
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional, Tuple
 
@@ -35,15 +36,23 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import Config
 from ..io.dataset import Dataset
+from ..models.tree import Tree
 from ..ops.histogram import build_histogram
 from ..ops.partition import split_decision_bins, split_decision_bins_cat
+from ..ops.quantize import int16_reduction_safe
 from ..ops.split import (SplitInfo, gather_feature_hist, pad_feature_meta,
                          per_feature_best, per_feature_best_categorical,
                          reduce_best_record, scan_meta_of)
-from ..treelearner.serial import SerialTreeLearner, _LeafState
+from ..treelearner.device import (REC, DeviceTreeLearner, _PendingTree,
+                                  make_sharded_grow_fn)
+from ..treelearner.serial import (SerialTreeLearner, _LeafState,
+                                  device_growth_applies)
+from ..utils.compat import shard_map
 from ..utils.log import Log
-from .dist import host_value, init_distributed, put_global, put_global_tree
-from .mesh import data_mesh
+from ..utils.timer import global_timer
+from .dist import (host_value, init_distributed, put_global, put_global_tree,
+                   put_replicated)
+from .mesh import data_mesh, padded_row_count
 
 
 def _ceil_to(n: int, d: int) -> int:
@@ -75,7 +84,7 @@ def _make_feature_scan_fn(mesh, f_local, has_cat: bool = False):
         all_recs = jax.lax.all_gather(recs, "data", axis=0, tiled=True)
         return reduce_best_record(all_recs)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         scan_block, mesh=mesh,
         in_specs=(P("data"), P(), P(), P("data"), P("data"), P()),
         out_specs=P(), check_vma=False))
@@ -103,8 +112,10 @@ class LeafIdPartition:
 
     def indices(self, leaf: int) -> np.ndarray:
         if self._host_ids is None:
-            ids = np.asarray(self._learner.leaf_id)
-            self._host_ids = ids[: self._learner.num_data]
+            # leaf_ids_dev() is already sliced to the real rows — one pull
+            # of exactly num_data ids (the old path pulled the padded
+            # vector and sliced on host)
+            self._host_ids = np.asarray(self.leaf_ids_dev())
         return np.nonzero(self._host_ids == leaf)[0].astype(np.int32)
 
     def invalidate(self) -> None:
@@ -168,7 +179,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
                                            tiled=True)
                 return red.astype(jnp.int32) if narrow else red
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(shard_map(
                 fh_block, mesh=mesh,
                 in_specs=(P(None, "data"), P("data"), P("data"), P(), P()),
                 out_specs=P("data")))
@@ -186,7 +197,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
                 vals = vals.astype(jnp.int32)
             return jax.lax.psum(vals.sum(axis=0), "data")
 
-        self._totals_fn = jax.jit(jax.shard_map(
+        self._totals_fn = jax.jit(shard_map(
             totals_fn, mesh=mesh,
             in_specs=(P("data"), P("data")), out_specs=P()))
 
@@ -202,7 +213,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
             left = jax.lax.psum((on_leaf & go_left).sum(), "data")
             return new_ids, left
 
-        self._partition_fn = jax.jit(jax.shard_map(
+        self._partition_fn = jax.jit(shard_map(
             partition_fn, mesh=mesh,
             in_specs=(P(None, "data"), P("data"), P(), P(), P(), P(), P(),
                       P()),
@@ -245,7 +256,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
         """All channel sums (and every ring partial sum) of a leaf's integer
         histogram are bounded by leaf_count * num_grad_quant_bins."""
         count = self.partition.counts.get(leaf, self.num_data)
-        return count * self.config.num_grad_quant_bins < 32000
+        return int16_reduction_safe(count, self.config.num_grad_quant_bins)
 
     def _root_totals(self, root_hist) -> Tuple[float, float, float]:
         tot = host_value(self._totals_fn(self._gh_sh, self.leaf_id))
@@ -359,7 +370,7 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             hist = build_histogram(bins_sh, ghm, bpad)
             return hist[None]  # stacked [1, G, Bpad, 3] per device
 
-        self._local_hist_fn = jax.jit(jax.shard_map(
+        self._local_hist_fn = jax.jit(shard_map(
             local_hist, mesh=mesh,
             in_specs=(P(None, "data"), P("data"), P("data"), P()),
             out_specs=P("data")))
@@ -399,7 +410,7 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
                 jnp.where(valid, selected.astype(jnp.float32), -1.0))
             return reduce_best_record(recs)
 
-        self._vote_scan_fn = jax.jit(jax.shard_map(
+        self._vote_scan_fn = jax.jit(shard_map(
             vote_scan, mesh=mesh,
             in_specs=(P("data"), P(), P(), P(), P(), P(), P()), out_specs=P(),
             check_vma=False))
@@ -436,6 +447,136 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
         return SplitInfo.from_packed(host_value(rec))
 
 
+class DeviceDataParallelTreeLearner(DeviceTreeLearner):
+    """tree_learner=data + device growth: the whole-tree wave learner
+    sharded data-parallel over the ICI mesh — ONE dispatch per tree across
+    every device (see treelearner/device.py make_sharded_grow_fn). The
+    host-driven DataParallelTreeLearner below stays the fallback for
+    configs the device grower cannot serve (categorical, per-node masks,
+    monotone, CEGB, linear trees — device_growth_applies)."""
+
+    def __init__(self, config: Config, dataset: Dataset) -> None:
+        from ..ops.compact_pallas import COMPACT_TILE
+        from ..ops.hist_pallas import DEFAULT_TILE_ROWS
+
+        self.mesh = data_mesh(config.num_machines)
+        self.D = int(self.mesh.devices.size)
+        # every shard must be a multiple of the wave tile unit so the
+        # shard_map body needs no per-device re-padding
+        self._row_unit = max(DEFAULT_TILE_ROWS, COMPACT_TILE)
+        self.n_pad = padded_row_count(dataset.num_data, self.D,
+                                      self._row_unit)
+        super().__init__(config, dataset)
+        F = len(self.meta.real_feature)
+        self.f_pad = _ceil_to(max(F, self.D), self.D)
+        self.f_local = self.f_pad // self.D
+        self.meta_pad = pad_feature_meta(self.meta, self.f_pad)
+        self.scan_meta_sharded = put_global_tree(
+            scan_meta_of(self.meta_pad), self.mesh, P("data"))
+        # full-feature raw gather tables ride replicated: every device
+        # gathers ALL features locally before the psum_scatter hands it
+        # its reduced feature block
+        self._gidx_rep = put_replicated(self.meta_pad.gather_index,
+                                        self.mesh)
+        self._vslot_rep = put_replicated(self.meta_pad.valid_slot, self.mesh)
+        self._tables_rep = put_replicated(self.tables, self.mesh)
+        self._params_rep = put_replicated(self.params_dev, self.mesh)
+        self._grow_fns = {}
+
+    def _device_bins(self, dataset: Dataset) -> jax.Array:
+        """Rows padded to the sharded tile unit and split on `data` (each
+        device holds its contiguous row block); same native-width rules as
+        the single-device learner."""
+        bins_pad = np.pad(dataset.bins,
+                          ((0, 0), (0, self.n_pad - dataset.num_data)))
+        if (bins_pad.dtype.itemsize == 1
+                and os.environ.get("LGBM_TPU_BINS_I32", "") == "1"):
+            bins_pad = bins_pad.astype(np.int32)
+        return put_global(bins_pad, self.mesh, P(None, "data"))
+
+    def _grow_fn(self, bagged: bool, narrow: bool):
+        key = (bagged, narrow)
+        if key not in self._grow_fns:
+            self._grow_fns[key] = make_sharded_grow_fn(
+                self.mesh, num_leaves=self.config.num_leaves,
+                num_bins=self.group_bin_padded,
+                max_depth=self.config.max_depth, quantized=self.quantized,
+                batch=self.wave, bagged=bagged, narrow=narrow)
+        return self._grow_fns[key]
+
+    def _record_ici_bytes(self, narrow: bool) -> None:
+        """Gauge: ICI bytes per wave — the psum_scatter'd [K, F_pad, Bmax,
+        CH] raw feature histograms plus the all_gathered [2K, F_pad, REC]
+        records. O(K*F*Bmax*CH): independent of the row count
+        (docs/PERF_NOTES.md comm-volume model); tests assert the
+        N-independence."""
+        K = max(1, min(self.wave, self.config.num_leaves))
+        pool_bytes = 2 if narrow else 4
+        global_timer.set_count(
+            "device_ici_bytes_per_wave",
+            K * self.f_pad * self.meta.max_bins * 3 * pool_bytes
+            + 2 * K * self.f_pad * REC * 4)
+
+    def train_async(self, gh_ext: jax.Array,
+                    bag_indices: Optional[np.ndarray] = None) -> _PendingTree:
+        cfg = self.config
+        n, npad = self.num_data, self.n_pad
+        if self.quantized:
+            gh_ext = self._prepare_gh(gh_ext)  # int8 rows + scales
+        gh = gh_ext[:-1]
+        if bag_indices is not None:
+            in_bag = np.zeros(n, dtype=bool)
+            in_bag[np.asarray(bag_indices, dtype=np.int64)] = True
+            gh = jnp.where(jnp.asarray(in_bag, dtype=jnp.bool_)[:, None], gh,
+                           jnp.zeros((), gh.dtype))
+            ids = np.where(in_bag, 0, -1).astype(np.int32)
+            n_bag = len(bag_indices)
+        else:
+            ids = np.zeros(n, dtype=np.int32)
+            n_bag = n
+        ids_pad = np.full(npad, -1, dtype=np.int32)
+        ids_pad[:n] = ids
+        gh_pad = jnp.concatenate(
+            [gh, jnp.zeros((npad - n, gh.shape[1]), gh.dtype)])
+        gh_sh = put_global(gh_pad, self.mesh, P("data"))
+        leaf_sh = put_global(ids_pad, self.mesh, P("data"))
+
+        F = len(self.meta.real_feature)
+        mask = np.ones(self.f_pad, dtype=bool)
+        if self.col_sampler.active:
+            mask[:F] = self.col_sampler.reset_by_tree()
+        fmask_sh = put_global(mask, self.mesh, P("data"))
+        scale = (self._scale_vec if self.quantized
+                 else jnp.ones(3, jnp.float32))
+        scale_rep = put_global(scale, self.mesh, P())
+
+        narrow = self.quantized and int16_reduction_safe(
+            n_bag, cfg.num_grad_quant_bins)
+        self._record_carry_bytes()
+        self._record_ici_bytes(narrow)
+        with global_timer.scope("tree_device"):
+            rec_store, leaf_id, _, hist_rows = self._grow_fn(
+                bag_indices is not None, narrow)(
+                jnp.copy(self.bins_dev), gh_sh, leaf_sh, self._gidx_rep,
+                self._vslot_rep, self.scan_meta_sharded, self._tables_rep,
+                self._params_rep, fmask_sh, scale_rep)
+        leaf_id = leaf_id[:n]
+        for arr in (rec_store, leaf_id, hist_rows):
+            start = getattr(arr, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        return _PendingTree(Tree(cfg.num_leaves), rec_store, leaf_id,
+                            hist_rows, n_bag)
+
+    def _renew_quantized_leaves_device(self, tree: Tree,
+                                       leaf_id: jax.Array) -> None:
+        # densify onto one device first: the parent's single scatter-add
+        # then sums in the SAME order as the single-device learner
+        # (sharded scatter-adds may reorder the f32 accumulation)
+        super()._renew_quantized_leaves_device(
+            tree, jnp.asarray(np.asarray(leaf_id)))
+
+
 def create_parallel_learner(learner_type: str, config: Config,
                             dataset: Dataset):
     from ..treelearner.cegb import CEGB
@@ -454,6 +595,12 @@ def create_parallel_learner(learner_type: str, config: Config,
         Log.fatal("use_quantized_grad is not supported with "
                   "tree_learner=voting (use data or feature)")
     if learner_type == "data":
+        # device growth shards the whole-tree wave learner over the mesh
+        # (one dispatch per tree); host-driven leaf-wise growth stays the
+        # fallback for configs the device grower cannot serve
+        if device_growth_applies(getattr(config, "device_type", "cpu"),
+                                 config, dataset):
+            return DeviceDataParallelTreeLearner(config, dataset)
         return DataParallelTreeLearner(config, dataset)
     if learner_type == "feature":
         return FeatureParallelTreeLearner(config, dataset)

@@ -14,6 +14,14 @@ import numpy as np
 from ..utils.log import Log
 
 
+def padded_row_count(num_rows: int, n_devices: int, unit: int = 1) -> int:
+    """Global row count padded so every device's shard is a multiple of
+    `unit` (the wave learner's tile unit): rows are first rounded up to a
+    per-device multiple of unit, then multiplied back out."""
+    per = -(-num_rows // (n_devices * unit)) * unit
+    return per * n_devices
+
+
 def data_mesh(num_machines: int = 0) -> jax.sharding.Mesh:
     """1-D mesh over the row-sharding axis ``data``.
 

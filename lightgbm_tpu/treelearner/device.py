@@ -55,7 +55,10 @@ import numpy as np
 
 from ..models.tree import Tree
 from ..ops.histogram import build_histogram
-from ..ops.split import SPLIT_FIELDS, SplitInfo, find_best_split
+from ..ops.split import (SPLIT_FIELDS, ScanMeta, SplitInfo, find_best_split,
+                         fix_feature_hist, gather_feature_hist_raw,
+                         per_feature_best, reduce_best_record)
+from ..utils.compat import shard_map
 from ..utils.log import Log
 from ..utils.timer import global_timer
 from .serial import SerialTreeLearner, _leaf_output_host
@@ -116,46 +119,49 @@ def _decide_go_left(gb, thresh, default_left, missing_type, default_bin,
     return jnp.where(is_missing, default_left, fbin <= thresh)
 
 
-# bins/gh/leaf_id0 are donated: each is a fresh per-tree buffer (the
-# learner COPIES bins_dev before the call) consumed by the wave loop, so
-# XLA reuses their allocations for the loop carries instead of double
-# buffering the two largest arrays. CPU backends ignore donation (warning
-# suppressed by Python's default dedup filter).
-@partial(jax.jit,
-         static_argnames=("num_leaves", "num_bins", "max_depth", "quantized",
-                          "batch", "bagged"),
-         donate_argnums=(0, 1, 2))
-def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
-                        meta, tables: FeatureTables, params: jax.Array,
-                        feature_mask: jax.Array,
-                        num_leaves: int, num_bins: int, max_depth: int,
-                        quantized: bool = False,
-                        scale_vec: Optional[jax.Array] = None,
-                        batch: int = 16, bagged: bool = False):
-    """Grow one leaf-wise tree fully on device, K splits per histogram pass.
+class ShardMeta(NamedTuple):
+    """Split-scan metadata for the ICI-sharded grower. The raw gather
+    tables span the FULL padded feature axis (every device gathers all
+    features from its local group histogram before the cross-device
+    psum_scatter hands it a feature block); `scan` holds only this
+    device's feature block."""
 
-    bins [G, N], gh [N, 3] (bagged-out rows must have zero gh),
-    leaf_id0 [N] (0 for in-bag rows, -1 otherwise; pass bagged=True when
-    any row is bagged out so the initial compaction runs).
-    quantized: gh is int8 (g_int, h_int, 1); histogram values stay exact
-    ints (int32 pool) and re-enter float space via scale_vec at scan time —
-    the on-device twin of the serial learner's quantized path.
+    gather_index: jax.Array  # [F_pad, Bmax] int32, replicated
+    valid_slot: jax.Array  # [F_pad, Bmax] bool, replicated
+    scan: ScanMeta  # this device's [f_local] feature block
 
-    Rows-in-leaf waves over a leaf-contiguous permutation: each WAVE takes
-    the top-K frontier leaves by gain, PARTITIONS each selected range into
-    left|right in place (stable; safe even if the replay later declines the
-    split — the range stays contiguous), histograms ONLY the smaller-child
-    subranges via ragged tiles (K*CH channels), derives the larger children
-    from the histogram pool by subtraction, then an on-device replay
-    commits splits in exact best-first order until the global argmax falls
-    outside the precomputed set (a child created this wave) — then the next
-    wave recomputes. Semantics are EXACTLY the reference's leaf-wise
-    best-first growth (serial_tree_learner.cpp:182): only histogram and
-    partition WORK is speculative, never split decisions. Histogrammed rows
-    per tree: N (root) + sum over waves of the selected smaller-child rows
-    — <= ~4N in practice vs O(N * waves) for full-N masked waves.
-    Returns (rec_store [L-1, STORE], leaf_id [N] in ORIGINAL row order,
-    num_leaves_final, hist_rows — rows histogrammed, the perf counter).
+
+# graftlint: disable=untimed-hot-func -- traced only inside the jitted grow_tree_on_device / make_sharded_grow_fn wrappers; every call site runs under the timed tree_device scope
+def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
+               meta, tables: FeatureTables, params: jax.Array,
+               feature_mask: jax.Array, scale_vec: Optional[jax.Array], *,
+               num_leaves: int, num_bins: int, max_depth: int,
+               quantized: bool, batch: int, bagged: bool,
+               sharded: bool, narrow: bool):
+    """Shared wave-loop body of the single-device and ICI-sharded growers.
+
+    sharded=False: `meta` is a FeatureMeta and everything is local — the
+    body of the public `grow_tree_on_device`.
+
+    sharded=True runs inside a `jax.shard_map` over the "data" mesh axis
+    (see make_sharded_grow_fn): bins/gh/leaf_id0 are this device's
+    leaf-contiguous row shard, `meta` is a ShardMeta, and per wave the
+    ONLY cross-device traffic — all of it O(K*F*Bmax*CH), independent of
+    the row count — is
+      * a psum of the K per-shard left counts, so the smaller/larger-child
+        choice and the subtraction pool key off GLOBAL row counts
+        (SyncUpGlobalBestSplit semantics, parallel_tree_learner.h:209);
+      * ONE psum_scatter merging the [K, F_pad, Bmax, CH] RAW smaller-child
+        feature histograms into per-device feature blocks (int16 when
+        `narrow` — the reference's int16 histogram reduction);
+      * an all_gather of the [2K, F_pad, REC] per-feature best records
+        before the replicated argmax.
+    Partition, ragged histograms, and the leaf-id relabel stay 100% local
+    (the CUDADataPartition-style local design); the best-first replay
+    consumes only replicated values, so every device commits the identical
+    tree. The histogram pool turns feature-major ([L+1, f_local, Bmax, CH]
+    raw reduced blocks) and is paired with replicated raw leaf totals +
+    global leaf counts so subtraction works on already-reduced data.
     """
     L = num_leaves
     G, N = bins.shape
@@ -205,11 +211,39 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
 
     # leaf-contiguous payload: gh channels + original position + leaf id,
     # all exact in f32 (positions < 2**24, ids < 2**8; quantized int8 gh
-    # values are exact too) and moved bit-exactly by the compaction kernel
+    # values are exact too) and moved bit-exactly by the compaction kernel.
+    # LGBM_TPU_GH_BF16=1 (opt-in, float path only): gh rides as bf16 PAIRS
+    # bitcast into f32 payload columns — half the gh carry bytes. The
+    # packed bits survive compaction unchanged (the kernel moves f32 limbs
+    # exactly) and are unpacked per histogram pass; bit-identity with the
+    # f32 path is NOT guaranteed (the learner warns once).
+    pack_bf16 = (not quantized) and os.environ.get(
+        "LGBM_TPU_GH_BF16", "").lower() in ("1", "true", "on")
+    if pack_bf16:
+        CHp = CH + (CH % 2)
+        ghb = gh.astype(jnp.float32).astype(jnp.bfloat16)
+        if CHp != CH:
+            ghb = jnp.pad(ghb, ((0, 0), (0, CHp - CH)))
+        gh_cols = jax.lax.bitcast_convert_type(
+            ghb.reshape(Np, CHp // 2, 2), jnp.float32)  # [Np, CHp//2]
+        n_gh = CHp // 2
+    else:
+        gh_cols = gh.astype(jnp.float32)
+        n_gh = CH
     row_p = jnp.concatenate([
-        gh.astype(jnp.float32), pos.astype(jnp.float32)[:, None],
-        leaf_id0.astype(jnp.float32)[:, None]], axis=1)  # [Np, CH+2]
-    LEAF_COL = CH + 1
+        gh_cols, pos.astype(jnp.float32)[:, None],
+        leaf_id0.astype(jnp.float32)[:, None]], axis=1)  # [Np, n_gh+2]
+    POS_COL = n_gh
+    LEAF_COL = n_gh + 1
+
+    def payload_gh(row_c):
+        """gh channels of a payload slice as f32 [rows, CH] (unpacks the
+        bf16 pairs when the narrow carry is on)."""
+        if not pack_bf16:
+            return row_c[:, :CH]
+        pairs = jax.lax.bitcast_convert_type(row_c[:, :n_gh], jnp.bfloat16)
+        return pairs.reshape(row_c.shape[0], 2 * n_gh)[:, :CH].astype(
+            jnp.float32)
 
     def scan_hist(hist):
         if quantized:
@@ -233,20 +267,68 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         leaf-contiguous ranges (slot must be the dump value outside).
         bins_c/row_c passed explicitly: inside the wave loop they are the
         CARRY arrays, not the pre-loop closure values."""
+        ghc = payload_gh(row_c)
         if use_kernels:
             tiles, nact = active_tile_table(starts, ends, valid, T_hist,
                                             DEFAULT_TILE_ROWS)
             h = pallas_histogram_slots_ragged(
-                bins_c, row_c[:, :CH], slot, tiles, nact, num_bins,
+                bins_c, ghc, slot, tiles, nact, num_bins,
                 n_slots, quantized=quantized, f32=hist_force_f32(),
                 interpret=interp)
             return h[:G]
         # XLA fallback: flat slot-expanded build over the full row set
         col_slot = jnp.arange(n_slots * CH, dtype=jnp.int32) // CH
         ghK = jnp.where(slot[:, None] == col_slot[None, :],
-                        jnp.tile(row_c[:, :CH], (1, n_slots)), 0.0)
+                        jnp.tile(ghc, (1, n_slots)), 0.0)
         h = build_histogram(bins_c[:G], ghK, num_bins)
         return h.astype(pool_dtype)  # quantized: exact ints below 2**24
+
+    if sharded:
+        gidx, vslot, sm = meta.gather_index, meta.valid_slot, meta.scan
+        F_pad, Bmax = gidx.shape
+        f_local = sm.default_bin.shape[0]
+        shard_off = (jax.lax.axis_index("data") * f_local).astype(
+            jnp.float32)
+
+        def raw_blocks(hists_k):
+            """[k, G, B, CH] raw local group hists -> [k, f_local, Bmax, CH]
+            RAW per-device feature blocks via ONE psum_scatter over the
+            padded feature axis — the wave's dominant ICI transfer
+            (K*F_pad*Bmax*CH values, int16 when `narrow`). The gather is a
+            pure selection, so it commutes bit-exactly with the reduction;
+            EFB reconstruction and scaling happen AFTER, on reduced blocks
+            with global totals, matching the single-device op order."""
+            fh = jax.vmap(
+                lambda h: gather_feature_hist_raw(h, gidx, vslot))(hists_k)
+            if narrow:
+                fh = fh.astype(jnp.int16)
+            blk = jax.lax.psum_scatter(fh, "data", scatter_dimension=1,
+                                       tiled=True)
+            return blk.astype(pool_dtype)
+
+        def scan_blocks(blk_raw, tot_raw, depths):
+            """[k, f_local, Bmax, CH] raw reduced blocks + [k, CH] raw
+            GLOBAL totals -> [k, REC] guarded globally-best records:
+            scale -> EFB fix -> local per-feature scan -> all_gather +
+            argmax (SyncUpGlobalBestSplit) — the sharded twin of
+            find_best_split over the same values."""
+            if quantized:
+                blk = blk_raw.astype(jnp.float32) * scale_vec
+                tot = tot_raw.astype(jnp.float32) * scale_vec[None, :]
+            else:
+                blk, tot = blk_raw, tot_raw
+            blk = jax.vmap(
+                lambda b, t: fix_feature_hist(b, t, sm.efb_omitted,
+                                              sm.default_bin))(blk, tot)
+            recs = jax.vmap(
+                lambda b, t: per_feature_best(b, t, sm, params,
+                                              feature_mask))(blk, tot)
+            feat = recs[:, :, 1]
+            recs = recs.at[:, :, 1].set(
+                jnp.where(feat >= 0, feat + shard_off, -1.0))
+            recs = jax.lax.all_gather(recs, "data", axis=1, tiled=True)
+            best = jax.vmap(reduce_best_record)(recs)
+            return jax.vmap(guard)(best, tot[:, 2], tot[:, 1], depths)
 
     # --- initial compaction: in-bag rows to the front, root = [0, n_in)
     if bagged:
@@ -259,6 +341,11 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
             bins_p, row_p, dst0, [in_bag, ~in_bag],
             jnp.ones(Np, bool), tile=COMPACT_TILE,
             use_pallas=use_kernels, interpret=interp)
+    elif sharded:
+        # the learner's global row padding trails the real rows, so every
+        # shard's real rows are already contiguous from 0 — count, don't
+        # compact
+        n_in = (leaf_id0 == 0).sum().astype(jnp.int32)
     else:
         n_in = jnp.int32(N)
 
@@ -270,16 +357,26 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
     root_hist = ranged_hist(
         bins_p, row_p, jnp.where(pos < n_in, 0, 1), 1,
         jnp.zeros(1, jnp.int32), n_in[None], jnp.ones(1, bool))
-    root_tot = hist_totals(root_hist)
-    pool = jnp.zeros((L + 1, G, num_bins, CH), pool_dtype).at[0].set(
-        root_hist)
     hist_rows = n_in  # instrumentation: rows histogrammed this tree
 
     depth = jnp.zeros(L + 1, jnp.int32)
     leaf_best = jnp.full((L + 1, REC), neg_inf, jnp.float32)
-    root_rec = guard(find_best_split(scan_hist(root_hist), root_tot, meta,
-                                     params, feature_mask),
-                     root_tot[2], root_tot[1], jnp.int32(0))
+    if sharded:
+        root_tot_raw = jax.lax.psum(root_hist[0].sum(axis=0), "data")
+        n_in_g = jax.lax.psum(n_in, "data")
+        pool = jnp.zeros((L + 1, f_local, Bmax, CH), pool_dtype).at[0].set(
+            raw_blocks(root_hist[None])[0])
+        tpool = jnp.zeros((L + 1, CH), pool_dtype).at[0].set(root_tot_raw)
+        count_g = jnp.zeros(L + 1, jnp.int32).at[0].set(n_in_g)
+        root_rec = scan_blocks(pool[0][None], root_tot_raw[None],
+                               jnp.zeros(1, jnp.int32))[0]
+    else:
+        root_tot = hist_totals(root_hist)
+        pool = jnp.zeros((L + 1, G, num_bins, CH), pool_dtype).at[0].set(
+            root_hist)
+        root_rec = guard(find_best_split(scan_hist(root_hist), root_tot,
+                                         meta, params, feature_mask),
+                         root_tot[2], root_tot[1], jnp.int32(0))
     leaf_best = leaf_best.at[0].set(root_rec)
     # one extra dump row at the end for masked-out replay writes
     rec_store = jnp.zeros((max(L - 1, 1) + 1, STORE), jnp.float32)
@@ -287,8 +384,12 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
     l1, l2, max_delta = params[0], params[1], params[5]
 
     def wave(carry):
-        (bins_p, row_p, start, count, depth, leaf_best, rec_store, pool,
-         n_cur, t, hist_rows) = carry
+        if sharded:
+            (bins_p, row_p, start, count, depth, leaf_best, rec_store, pool,
+             n_cur, t, hist_rows, tpool, count_g) = carry
+        else:
+            (bins_p, row_p, start, count, depth, leaf_best, rec_store, pool,
+             n_cur, t, hist_rows) = carry
         gains = leaf_best[:L, 0]
         sel_gain, sel = jax.lax.top_k(gains, K)  # [K] distinct leaves
         sel = sel.astype(jnp.int32)
@@ -355,9 +456,20 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         # --- ragged histogram of ONLY the smaller children; tie -> left,
         # matching the serial learner's _apply_split choice
         nr_k = c_k - nl_k
-        left_small = nl_k <= nr_k
+        if sharded:
+            # smaller/larger child by GLOBAL row counts (psum of the
+            # per-shard left counts — SyncUpGlobalBestSplit semantics):
+            # every device histograms its LOCAL rows of the globally
+            # smaller child, whatever their local count
+            nl_g = jax.lax.psum(nl_k, "data")
+            c_g = jnp.take(count_g, sel)
+            nr_g = c_g - nl_g
+            left_small = nl_g <= nr_g
+            sc_k = jnp.where(left_small, nl_k, nr_k)
+        else:
+            left_small = nl_k <= nr_k
+            sc_k = jnp.minimum(nl_k, nr_k)
         ss_k = jnp.where(left_small, s_k, s_k + nl_k)
-        sc_k = jnp.minimum(nl_k, nr_k)
         se_k = ss_k + sc_k
         inS = ((pos[:, None] >= ss_k[None, :])
                & (pos[:, None] < se_k[None, :]) & sel_ok[None, :])
@@ -368,21 +480,46 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
                             sel_ok & (sc_k > 0))
         histS_k = jnp.moveaxis(
             histS.reshape(G, num_bins, K, CH), 2, 0)  # [K, G, B, CH]
-        pool_sel = jnp.take(pool, sel, axis=0)  # [K, G, B, CH]
-        histL = jnp.where(left_small[:, None, None, None], histS_k,
-                          pool_sel - histS_k)
-        histR = pool_sel - histL  # subtract_histogram, vectorized
-        hists = jnp.stack([histL, histR], axis=1).reshape(
-            2 * K, G, num_bins, CH)
-        totals = hists[:, 0].sum(axis=1)  # [2K, B, CH] bins-summed -> [2K, CH]
-        if quantized:
-            totals = totals.astype(jnp.float32) * scale_vec[None, :]
         child_depth = depth[sel] + 1  # [K]
         depth2 = jnp.repeat(child_depth, 2)  # [2K]
-        recs2 = jax.vmap(
-            lambda h, tot: find_best_split(scan_hist(h), tot, meta, params,
-                                           feature_mask))(hists, totals)
-        recs2 = jax.vmap(guard)(recs2, totals[:, 2], totals[:, 1], depth2)
+        if sharded:
+            # global raw totals of the smaller children, then ONE
+            # psum_scatter merges the raw gathered feature hists into this
+            # device's reduced block; subtraction happens on reduced data
+            totS_raw = jax.lax.psum(histS_k[:, 0].sum(axis=1), "data")
+            blkS = raw_blocks(histS_k)  # [K, f_local, Bmax, CH]
+            pool_sel = jnp.take(pool, sel, axis=0)
+            tp_sel = jnp.take(tpool, sel, axis=0)  # [K, CH]
+            histL = jnp.where(left_small[:, None, None, None], blkS,
+                              pool_sel - blkS)
+            histR = pool_sel - histL  # subtract_histogram, on blocks
+            totL_raw = jnp.where(left_small[:, None], totS_raw,
+                                 tp_sel - totS_raw)
+            totR_raw = tp_sel - totL_raw
+            hists = jnp.stack([histL, histR], axis=1).reshape(
+                2 * K, f_local, Bmax, CH)
+            tot2_raw = jnp.stack([totL_raw, totR_raw], axis=1).reshape(
+                2 * K, CH)
+            totals = tot2_raw
+            if quantized:
+                totals = totals.astype(jnp.float32) * scale_vec[None, :]
+            recs2 = scan_blocks(hists, tot2_raw, depth2)
+        else:
+            pool_sel = jnp.take(pool, sel, axis=0)  # [K, G, B, CH]
+            histL = jnp.where(left_small[:, None, None, None], histS_k,
+                              pool_sel - histS_k)
+            histR = pool_sel - histL  # subtract_histogram, vectorized
+            hists = jnp.stack([histL, histR], axis=1).reshape(
+                2 * K, G, num_bins, CH)
+            totals = hists[:, 0].sum(axis=1)  # bins-summed -> [2K, CH]
+            if quantized:
+                totals = totals.astype(jnp.float32) * scale_vec[None, :]
+            recs2 = jax.vmap(
+                lambda h, tot: find_best_split(scan_hist(h), tot, meta,
+                                               params, feature_mask))(
+                hists, totals)
+            recs2 = jax.vmap(guard)(recs2, totals[:, 2], totals[:, 1],
+                                    depth2)
 
         # --- exact best-first replay over the precomputed set
         def replay_step(_, rp):
@@ -447,6 +584,11 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         mid_k = s_k + nl_k
         start = start.at[wnK].set(mid_k)
         count = count.at[wnK].set(nr_k).at[wbK].set(nl_k)
+        if sharded:
+            # replicated raw totals + GLOBAL counts ride with the feature-
+            # block pool so later subtractions stay reduction-free
+            tpool = tpool.at[wbK].set(totL_raw).at[wnK].set(totR_raw)
+            count_g = count_g.at[wnK].set(nr_g).at[wbK].set(nl_g)
 
         # per-row leaf relabel via the same stacked masked matmul (position
         # >= split midpoint <=> right child, thanks to the partition)
@@ -457,6 +599,9 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         is_right = com_row & (pos >= rowsP[:, 2].astype(jnp.int32))
         leafcol = jnp.where(is_right, rowsP[:, 1], row_p[:, LEAF_COL])
         row_p = row_p.at[:, LEAF_COL].set(leafcol)
+        if sharded:
+            return (bins_p, row_p, start, count, depth, leaf_best,
+                    rec_store, pool, n_cur, t, hist_rows, tpool, count_g)
         return (bins_p, row_p, start, count, depth, leaf_best, rec_store,
                 pool, n_cur, t, hist_rows)
 
@@ -466,16 +611,111 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
 
     carry = (bins_p, row_p, start, count, depth, leaf_best, rec_store, pool,
              jnp.int32(1), jnp.int32(0), hist_rows)
+    if sharded:
+        carry = carry + (tpool, count_g)
     if L > 1:
         carry = jax.lax.while_loop(cond, wave, carry)
     row_p, rec_store, n_cur, hist_rows = carry[1], carry[6], carry[8], \
         carry[10]
+    if sharded:
+        hist_rows = jax.lax.psum(hist_rows, "data")
     # undo the permutation without a TPU scatter: sort leaf ids by the
     # original-position column (both exact small ints in f32)
     _, leaf_sorted = jax.lax.sort_key_val(
-        row_p[:, CH].astype(jnp.int32),
+        row_p[:, POS_COL].astype(jnp.int32),
         row_p[:, LEAF_COL].astype(jnp.int32))
     return rec_store[:-1], leaf_sorted[:N], n_cur, hist_rows
+
+
+# bins/gh/leaf_id0 are donated: each is a fresh per-tree buffer (the
+# learner COPIES bins_dev before the call) consumed by the wave loop, so
+# XLA reuses their allocations for the loop carries instead of double
+# buffering the two largest arrays. CPU backends ignore donation (warning
+# suppressed by Python's default dedup filter).
+@partial(jax.jit,
+         static_argnames=("num_leaves", "num_bins", "max_depth", "quantized",
+                          "batch", "bagged"),
+         donate_argnums=(0, 1, 2))
+def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
+                        meta, tables: FeatureTables, params: jax.Array,
+                        feature_mask: jax.Array,
+                        num_leaves: int, num_bins: int, max_depth: int,
+                        quantized: bool = False,
+                        scale_vec: Optional[jax.Array] = None,
+                        batch: int = 16, bagged: bool = False):
+    """Grow one leaf-wise tree fully on device, K splits per histogram pass.
+
+    bins [G, N], gh [N, 3] (bagged-out rows must have zero gh),
+    leaf_id0 [N] (0 for in-bag rows, -1 otherwise; pass bagged=True when
+    any row is bagged out so the initial compaction runs).
+    quantized: gh is int8 (g_int, h_int, 1); histogram values stay exact
+    ints (int32 pool) and re-enter float space via scale_vec at scan time —
+    the on-device twin of the serial learner's quantized path.
+
+    Rows-in-leaf waves over a leaf-contiguous permutation: each WAVE takes
+    the top-K frontier leaves by gain, PARTITIONS each selected range into
+    left|right in place (stable; safe even if the replay later declines the
+    split — the range stays contiguous), histograms ONLY the smaller-child
+    subranges via ragged tiles (K*CH channels), derives the larger children
+    from the histogram pool by subtraction, then an on-device replay
+    commits splits in exact best-first order until the global argmax falls
+    outside the precomputed set (a child created this wave) — then the next
+    wave recomputes. Semantics are EXACTLY the reference's leaf-wise
+    best-first growth (serial_tree_learner.cpp:182): only histogram and
+    partition WORK is speculative, never split decisions. Histogrammed rows
+    per tree: N (root) + sum over waves of the selected smaller-child rows
+    — <= ~4N in practice vs O(N * waves) for full-N masked waves.
+    Returns (rec_store [L-1, STORE], leaf_id [N] in ORIGINAL row order,
+    num_leaves_final, hist_rows — rows histogrammed, the perf counter).
+    """
+    return _grow_impl(bins, gh, leaf_id0, meta, tables, params, feature_mask,
+                      scale_vec, num_leaves=num_leaves, num_bins=num_bins,
+                      max_depth=max_depth, quantized=quantized, batch=batch,
+                      bagged=bagged, sharded=False, narrow=False)
+
+
+def make_sharded_grow_fn(mesh, *, num_leaves: int, num_bins: int,
+                         max_depth: int, quantized: bool, batch: int,
+                         bagged: bool, narrow: bool = False):
+    """jit(shard_map) whole-tree grower, data-parallel over the "data" mesh
+    axis: one dispatch per tree across every device.
+
+    Call signature of the returned fn (all arrays GLOBAL, rows padded by
+    the caller to a per-shard multiple of the wave tile unit so each
+    device's shard needs no further padding):
+
+        fn(bins [G, Np], gh [Np, CH], leaf_id0 [Np],
+           gather_index [F_pad, Bmax], valid_slot [F_pad, Bmax],
+           scan_meta (ScanMeta over [F_pad], feature-sharded),
+           tables, params, feature_mask [F_pad], scale_vec [CH])
+
+    bins/gh/leaf_id0/feature_mask arrive row-/feature-sharded on "data";
+    gather tables, decision tables, params and scale_vec replicated.
+    scale_vec must be a real array even when quantized=False (pass ones —
+    it is ignored). Categorical splits are not supported here (the factory
+    routes categorical configs to the host-driven learners). Returns the
+    same (rec_store, leaf_id [Np] global original order, n_cur, hist_rows)
+    as grow_tree_on_device; rec_store/n_cur/hist_rows are replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def body(bins, gh, leaf_id0, gather_index, valid_slot, scan_meta,
+             tables, params, feature_mask, scale_vec):
+        meta = ShardMeta(gather_index, valid_slot, scan_meta)
+        return _grow_impl(bins, gh, leaf_id0, meta, tables, params,
+                          feature_mask,
+                          scale_vec if quantized else None,
+                          num_leaves=num_leaves, num_bins=num_bins,
+                          max_depth=max_depth, quantized=quantized,
+                          batch=batch, bagged=bagged, sharded=True,
+                          narrow=narrow)
+
+    return jax.jit(shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, "data"), P("data"), P("data"), P(), P(),
+                  P("data"), P(), P(), P("data"), P()),
+        out_specs=(P(), P("data"), P(), P()),
+        check_vma=False), donate_argnums=(0, 1, 2))
 
 
 class DevicePartition:
@@ -550,6 +790,25 @@ class DeviceTreeLearner(SerialTreeLearner):
         # 21 -> 126 channels (one 128-lane M-tile on the MXU); raise for
         # deeper amortization, lower if speculation hit-rate drops.
         self.wave = int(os.environ.get("LGBM_TPU_WAVE", "21"))
+        self._gh_bf16 = (not self.quantized) and os.environ.get(
+            "LGBM_TPU_GH_BF16", "").lower() in ("1", "true", "on")
+        if os.environ.get("LGBM_TPU_GH_BF16", "").lower() in (
+                "1", "true", "on"):
+            if self.quantized:
+                Log.warning("LGBM_TPU_GH_BF16=1 is ignored with "
+                            "use_quantized_grad (the int8 payload is "
+                            "already narrow)")
+            else:
+                Log.warning(
+                    "LGBM_TPU_GH_BF16=1: gh wave-carry payload packed as "
+                    "bf16 — bit-identity with the f32 path is NOT "
+                    "guaranteed (bf16 keeps 8 mantissa bits)")
+
+    def _payload_cols(self) -> int:
+        """Payload columns of the wave carry: gh channels (bf16-packed in
+        pairs when opted in) + position + leaf id."""
+        n_gh = 2 if self._gh_bf16 else 3
+        return n_gh + 2
 
     def _record_carry_bytes(self) -> None:
         """Gauge: HBM bytes of the per-wave loop carry (bin plane + row
@@ -565,7 +824,7 @@ class DeviceTreeLearner(SerialTreeLearner):
         Gp = -(-G // 32) * 32 if plane_b == 1 else -(-G // 8) * 8
         global_timer.set_count(
             "device_carry_bytes_per_wave",
-            Gp * np_rows * plane_b + np_rows * 5 * 4)  # bins + [N, CH+2] f32
+            Gp * np_rows * plane_b + np_rows * self._payload_cols() * 4)
 
     def train(self, gh_ext: jax.Array,
               bag_indices: Optional[np.ndarray] = None) -> Tree:

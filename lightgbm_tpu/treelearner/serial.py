@@ -210,7 +210,16 @@ class SerialTreeLearner:
         scales right before the split scan."""
         if not self.quantized:
             return hist
-        return hist.astype(jnp.float32) * self._scale_vec
+        scale = self._scale_vec
+        # the distributed learners hand over mesh-committed histograms;
+        # the per-tree scales come off the default device — replicate them
+        # onto the same mesh once so the multiply has one device set
+        if (isinstance(hist.sharding, jax.sharding.NamedSharding)
+                and scale.sharding.device_set != hist.sharding.device_set):
+            scale = jax.device_put(scale, jax.sharding.NamedSharding(
+                hist.sharding.mesh, jax.sharding.PartitionSpec()))
+            self._scale_vec = scale
+        return hist.astype(jnp.float32) * scale
 
     def _begin_tree(self, gh_ext: jax.Array,
                     bag_indices: Optional[np.ndarray]) -> None:
@@ -547,6 +556,37 @@ def _leaf_output_host(sum_g: float, sum_h: float, l1: float, l2: float,
     return float(out)
 
 
+def device_growth_applies(device_type: str, config: Config,
+                          dataset: Dataset) -> bool:
+    """Whether the on-device whole-tree wave learner can serve this config.
+
+    The wave learner trades O(leaf) index gathers for O(N) static-shape
+    masked histograms — near-free on the MXU, slow on the CPU backend — so
+    it is selected on accelerators only; device_type=cpu forces the
+    host-driven learner regardless of the attached backend (device_type
+    defaults to "auto": see Config._post_process). Shared by the serial
+    factory below and the data-parallel factory (parallel/learners.py),
+    which stacks its sharded grower on the same device-growth conditions.
+    """
+    try:
+        on_accelerator = jax.default_backend() not in ("cpu",)
+    except RuntimeError:
+        on_accelerator = False
+    has_cat = any(dataset.mappers[f].bin_type == 1
+                  for f in dataset.used_features)
+    # per-node feature masks / per-leaf bounds and penalties need the
+    # host-driven loop for now
+    needs_host = (config.feature_fraction_bynode < 1.0
+                  or bool(config.interaction_constraints)
+                  or bool(dataset.monotone_constraints
+                          and any(dataset.monotone_constraints))
+                  or CEGB.enabled(config)
+                  or config.linear_tree
+                  or bool(config.forcedsplits_filename))
+    return (device_type != "cpu" and on_accelerator and not has_cat
+            and not needs_host)
+
+
 def create_tree_learner(learner_type: str, device_type: str, config: Config,
                         dataset: Dataset):
     """Factory (tree_learner.cpp:17-57). Distributed learners (feature/data/
@@ -554,29 +594,7 @@ def create_tree_learner(learner_type: str, device_type: str, config: Config,
     if learner_type in ("serial",):
         from .device import DeviceTreeLearner
 
-        # The on-device whole-tree learner trades O(leaf) index gathers for
-        # O(N) static-shape masked histograms — near-free on the MXU, slow on
-        # the CPU backend — so it is selected on accelerators only;
-        # device_type=cpu forces the host-driven learner regardless of the
-        # attached backend (device_type defaults to "auto": see
-        # Config._post_process).
-        try:
-            on_accelerator = jax.default_backend() not in ("cpu",)
-        except RuntimeError:
-            on_accelerator = False
-        has_cat = any(dataset.mappers[f].bin_type == 1
-                      for f in dataset.used_features)
-        # per-node feature masks / per-leaf bounds and penalties need the
-        # host-driven loop for now
-        needs_host = (config.feature_fraction_bynode < 1.0
-                      or bool(config.interaction_constraints)
-                      or bool(dataset.monotone_constraints
-                              and any(dataset.monotone_constraints))
-                      or CEGB.enabled(config)
-                      or config.linear_tree
-                      or bool(config.forcedsplits_filename))
-        if device_type != "cpu" and on_accelerator and not has_cat \
-                and not needs_host:
+        if device_growth_applies(device_type, config, dataset):
             return DeviceTreeLearner(config, dataset)
         return SerialTreeLearner(config, dataset)
     if learner_type in ("feature", "data", "voting"):

@@ -32,3 +32,15 @@ def pytest_configure(config):
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+@pytest.fixture(autouse=True)
+def _log_state_isolated():
+    """Log verbosity and callback are process globals (the CLI sets them);
+    restore them so a `verbosity=-1` run can't mute a later test's
+    warning assertions."""
+    from lightgbm_tpu.utils import log as _log
+
+    verbosity, callback = _log._verbosity, _log._callback
+    yield
+    _log._verbosity, _log._callback = verbosity, callback

@@ -1,0 +1,51 @@
+"""R7 fixture: collectives must name an axis bound by a shard_map."""
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def reduce_block(x):
+    # reached from `wrapped` below: "data" is bound -> clean
+    return jax.lax.psum_scatter(x, "data", scatter_dimension=0, tiled=True)
+
+
+def wrapped(x):
+    y = jax.lax.psum(x, "data")  # bound by the shard_map below: clean
+    return reduce_block(y)
+
+
+def make(mesh):
+    return jax.shard_map(wrapped, mesh=mesh, in_specs=(P("data"),),
+                         out_specs=P())
+
+
+def wrong_axis(x):
+    return jax.lax.psum(x, "batch")  # line 22: VIOLATION (unbound axis)
+
+
+def never_wrapped(x):
+    return jax.lax.all_gather(x, "data")  # line 26: VIOLATION (no shard_map)
+
+
+def computed_axis(x, ax):
+    return jax.lax.psum(x, ax)  # line 30: VIOLATION (non-literal axis)
+
+
+def no_axis(x):
+    return jax.lax.psum(x)  # line 34: VIOLATION (axis name missing)
+
+
+def suppressed_gather(x):
+    # graftlint: disable=collective-axis -- fixture: axis bound by the caller's shard_map in another module
+    return jax.lax.all_gather(x, "model", axis=0, tiled=True)
+
+
+def outer(mesh):
+    def inner(x):
+        # reached from the wrapped body below via a call edge: clean
+        return jax.lax.psum(x, "rows")
+
+    def body(x):
+        return inner(x)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(P("rows"),),
+                         out_specs=P("rows"))

@@ -154,6 +154,31 @@ def test_r6_suppression_honored(fixture_result):
     assert "reused across iterations" in sup[0].reason
 
 
+# -- R7 collective axis binding -------------------------------------------
+
+def test_r7_unbound_collectives_detected(fixture_result):
+    bad = _hits(fixture_result, "collective-axis", "parallel/r7_axis.py")
+    msgs = {v.line: v.message for v in bad}
+    assert set(msgs) == {22, 26, 30, 34}
+    assert "'batch'" in msgs[22]       # axis not bound anywhere
+    assert "no shard_map" in msgs[26]  # function never wrapped
+    assert "not a string literal" in msgs[30]
+    assert "without an axis name" in msgs[34]
+
+
+def test_r7_wrapped_chain_and_nested_are_clean(fixture_result):
+    # psum/psum_scatter reached from shard_map-wrapped fns (directly, via a
+    # module call edge, and from a nested def) must not fire
+    lines = {v.line for v in
+             _hits(fixture_result, "collective-axis", "parallel/r7_axis.py")}
+    assert not lines & {8, 12, 44}
+
+
+def test_r7_suppression_honored(fixture_result):
+    sup = _hits(fixture_result, "collective-axis", suppressed=True)
+    assert len(sup) == 1 and "bound by the caller's shard_map" in sup[0].reason
+
+
 # -- S1 directive hygiene -------------------------------------------------
 
 def test_s1_bad_directives_are_findings(fixture_result):
@@ -191,11 +216,11 @@ def test_ignore_filters_rules():
 
 def test_rule_codes_cover_names_and_codes():
     table = rule_codes()
-    for ident in ("R1", "R2", "R3", "R4", "R5", "R6", "jit-donation",
+    for ident in ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "jit-donation",
                   "jit-host-sync",
                   "implicit-dtype", "pallas-tile-shape",
                   "pallas-prefetch-arity", "pallas-host-op",
-                  "param-unread", "untimed-hot-func"):
+                  "param-unread", "untimed-hot-func", "collective-axis"):
         assert ident in table
 
 

@@ -7,6 +7,7 @@ from __future__ import annotations
 from typing import Dict, List
 
 from .base import Rule
+from .collective_axis import CollectiveAxisRule
 from .donation import DonationRule
 from .dtype_discipline import DtypeDisciplineRule
 from .jit_boundary import JitBoundaryRule
@@ -21,6 +22,7 @@ RULES: List[Rule] = [
     ParamConsistencyRule(),
     TimerDisciplineRule(),
     DonationRule(),
+    CollectiveAxisRule(),
 ]
 
 # rule name -> R-code for ids emitted by rules beyond their primary name

@@ -1,0 +1,178 @@
+"""R7 collective-axis: collectives must name an axis bound by a shard_map.
+
+`jax.lax.psum` / `psum_scatter` / `all_gather` resolve their axis name
+against the innermost surrounding `shard_map` (or pmap) binding it. A
+collective whose axis name is a typo, computed at runtime, or simply not
+bound by the shard_map that ultimately traces the function fails at TRACE
+time at best — and at worst traces fine under one call path and explodes
+when a refactor moves the function out from under its mapping wrapper.
+The sharded device learner's collectives all ride the ``data`` mesh axis
+through functions several call levels below the `jax.shard_map` call, so
+the binding is invisible at the call site; this rule makes it checkable.
+
+The check is module-local and conservative:
+
+* every `shard_map(fn, ...)` call in the module contributes its STRING
+  literals (the axis names in axis_names and the PartitionSpecs of
+  in_specs/out_specs) to the bound-axis set of the wrapped function
+  `fn` (first positional argument, plain name);
+* bound axes flow to lexically nested defs (they trace inside the
+  wrapper) and — to a fixpoint — through plain-name calls to other
+  functions in the module (the sharded learner's
+  `body -> _grow_impl -> raw_blocks` chain);
+* a collective call anywhere else in the module, or naming an axis not
+  in its bound set, or passing a non-literal axis name, is a violation.
+
+Functions the module never routes through a shard_map are still checked:
+a bare collective in a module with no shard_map at all is exactly the
+refactor hazard above. Modules outside the accelerator surface
+(parallel/, treelearner/, models/, ops/) are not scanned.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from ..core import (Package, Violation, dotted_name, functions_with_parents,
+                    keyword_arg)
+from .base import Rule
+
+_COLLECTIVES = {"psum", "psum_scatter", "all_gather"}
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _last_segment(node: ast.AST) -> str:
+    name = dotted_name(node)
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _string_literals(node: ast.AST) -> Set[str]:
+    return {sub.value for sub in ast.walk(node)
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str)}
+
+
+def _axis_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The axis-name argument of a collective call: every jax.lax
+    collective takes it as the second positional or `axis_name=`."""
+    kw = keyword_arg(call, "axis_name")
+    if kw is not None:
+        return kw
+    if len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+def _own_calls(root: ast.AST) -> Iterator[ast.Call]:
+    """Call nodes whose innermost enclosing def is `root` (does not
+    descend into nested defs; lambdas are not a binding scope here)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _DEFS):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class CollectiveAxisRule(Rule):
+    name = "collective-axis"
+    code = "R7"
+    description = ("psum/psum_scatter/all_gather whose axis name is not a "
+                   "literal bound by a shard_map in the same module")
+    scope_prefixes = ("parallel/", "treelearner/", "models/", "ops/")
+
+    def check(self, pkg: Package) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for ctx in self.scoped(pkg):
+            out.extend(self._check_module(ctx))
+        return out
+
+    def _check_module(self, ctx) -> List[Violation]:
+        tree = ctx.tree
+        all_defs: List[Tuple[ast.AST, Tuple[ast.AST, ...]]] = [
+            (fn, tuple(a for a in chain if isinstance(a, _DEFS)))
+            for fn, chain in functions_with_parents(tree)]
+        by_name: Dict[str, List[ast.AST]] = {}
+        for fn, _ in all_defs:
+            by_name.setdefault(fn.name, []).append(fn)
+
+        # 1. axes bound directly: shard_map(fn, ...) seeds fn with every
+        #    string literal in the call (axis tuple + PartitionSpecs)
+        direct: Dict[ast.AST, Set[str]] = {}
+        for call in ast.walk(tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if _last_segment(call.func) != "shard_map":
+                continue
+            if not call.args or not isinstance(call.args[0], ast.Name):
+                continue
+            axes = _string_literals(call)
+            for target in by_name.get(call.args[0].id, []):
+                direct.setdefault(target, set()).update(axes)
+
+        def effective(fn: ast.AST, ancestors: Tuple[ast.AST, ...]) -> Set[str]:
+            eff = set(direct.get(fn, ()))
+            for anc in ancestors:
+                eff |= direct.get(anc, set())
+            return eff
+
+        # 2. fixpoint: a wrapped function's axes flow through plain-name
+        #    call edges to same-module functions (body -> _grow_impl ->
+        #    nested helpers); lexical nesting flows via effective() above
+        changed = True
+        while changed:
+            changed = False
+            for fn, ancestors in all_defs:
+                eff = effective(fn, ancestors)
+                if not eff:
+                    continue
+                for call in _own_calls(fn):
+                    if not isinstance(call.func, ast.Name):
+                        continue
+                    for target in by_name.get(call.func.id, []):
+                        have = direct.setdefault(target, set())
+                        if not eff <= have:
+                            have |= eff
+                            changed = True
+
+        # 3. every collective checks against its innermost def's effective
+        #    axes; module-level calls have nothing bound
+        out: List[Violation] = []
+        for fn, ancestors in all_defs:
+            axes = effective(fn, ancestors)
+            for call in _own_calls(fn):
+                out.extend(self._check_call(ctx, call, axes))
+        for call in _own_calls(tree):
+            out.extend(self._check_call(ctx, call, set()))
+        return out
+
+    def _check_call(self, ctx, call: ast.Call,
+                    axes: Set[str]) -> List[Violation]:
+        op = _last_segment(call.func)
+        if op not in _COLLECTIVES:
+            return []
+        axis = _axis_arg(call)
+        if axis is None:
+            return [self.violation(
+                ctx, call,
+                "%s without an axis name — collectives must name the "
+                "shard_map axis they reduce over" % op)]
+        if not (isinstance(axis, ast.Constant)
+                and isinstance(axis.value, str)):
+            return [self.violation(
+                ctx, call,
+                "%s axis name is not a string literal — the binding to an "
+                "enclosing shard_map cannot be checked" % op)]
+        if axis.value not in axes:
+            if axes:
+                detail = "the enclosing shard_map binds only %s" % (
+                    ", ".join(repr(a) for a in sorted(axes)))
+            else:
+                detail = ("no shard_map in this module wraps a function "
+                          "reaching this call")
+            return [self.violation(
+                ctx, call,
+                "%s over axis %r which is not bound here — %s"
+                % (op, axis.value, detail))]
+        return []
