@@ -174,6 +174,18 @@ def run_bench(n_rows: int) -> dict:
            "auc": round(_auc(yh, bst.predict(Xh)), 4)}
     out.update(_wave_traffic_fields(ds))
 
+    # inference throughput: chunked streaming predict over the train matrix
+    # (the serving configuration — double-buffered H2D/compute/D2H overlap)
+    from lightgbm_tpu.ops.partition import bucket_size
+
+    pred_chunk = min(1 << 20, bucket_size(max(n_rows // 4, 1), 1024))
+    bst.predict(X, raw_score=True, pred_chunk_rows=pred_chunk)  # compile warmup
+    t0 = time.perf_counter()
+    bst.predict(X, raw_score=True, pred_chunk_rows=pred_chunk)
+    pe = time.perf_counter() - t0
+    out["predict_rows_per_sec"] = round(n_rows / pe, 1)
+    out["predict_chunk_rows"] = pred_chunk
+
     # secondary quantized capture defaults ON only at moderate sizes — at
     # full HIGGS scale it would double the remote-compile + train time and
     # risk the round's single capture window
@@ -239,7 +251,8 @@ def main() -> None:
             record["iters"] = res["iters"]
             for k in ("auc", "quantized_row_iters_per_sec", "quantized_auc",
                       "quantized_error", "device_hist_rows",
-                      "est_carried_bytes_per_wave"):
+                      "est_carried_bytes_per_wave", "predict_rows_per_sec",
+                      "predict_chunk_rows"):
                 if k in res:
                     record[k] = res[k]
             emit(record)

@@ -400,6 +400,7 @@ class Booster:
             self.config = Config(self.params)
             self._gbdt = GBDT(self.config, None, None)
             self._gbdt.models = model.trees
+            self._gbdt._predictor.invalidate()
             self._gbdt.num_class = model.num_class
             self._gbdt.num_tree_per_iteration = model.num_tree_per_iteration
             # restore the iteration counter (GBDT::LoadModelFromString sets
@@ -474,6 +475,7 @@ class Booster:
         new_booster = Booster(new_params, train_set)
         new_booster._gbdt.models = GBDTModel.from_string(
             self.model_to_string()).trees
+        new_booster._gbdt._predictor.invalidate()
         new_booster._gbdt.iter_ = (len(new_booster._gbdt.models)
                                    // new_booster._gbdt.num_tree_per_iteration)
         new_booster._gbdt.refit(
@@ -534,7 +536,14 @@ class Booster:
                 **kwargs) -> np.ndarray:
         if _is_dataframe(data) and self.pandas_categorical:
             data = _pandas_to_matrix(data, self.pandas_categorical)[0]
-        X = _to_2d_float(data).astype(np.float32)
+        # keep the caller's f32/f64 values: models/gbdt.py routes the device
+        # dtype (f64 stays f64 under jax x64; otherwise the pack-time
+        # round-toward--inf threshold downcast keeps f32 bit-exact)
+        if isinstance(data, np.ndarray) and data.dtype == np.float32 \
+                and data.ndim == 2:
+            X = data
+        else:
+            X = _to_2d_float(data)
         if num_iteration is None:
             # best-iteration truncation applies to whole-model predicts only;
             # an explicit start_iteration means "this slice onward"
@@ -550,6 +559,9 @@ class Booster:
             C = self._gbdt.num_tree_per_iteration
             trees = self._gbdt.models[max(start_iteration, 0) * C:]
             return predict_contrib(trees, X, C, num_iteration)
+        chunk_kw = kwargs.get("pred_chunk_rows",
+                              self.params.get("pred_chunk_rows"))
+        chunk_rows = int(chunk_kw) if chunk_kw is not None else None
         if param_bool(kwargs.get("pred_early_stop",
                                  self.params.get("pred_early_stop"))):
             return self._gbdt.predict(
@@ -563,7 +575,8 @@ class Booster:
                         self.params.get("pred_early_stop_margin", 10.0)))))
         return self._gbdt.predict(X, raw_score=raw_score,
                                   num_iteration=num_iteration,
-                                  start_iteration=start_iteration)
+                                  start_iteration=start_iteration,
+                                  chunk_rows=chunk_rows)
 
     # ------------------------------------------------------------------ model
 

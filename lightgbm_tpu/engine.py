@@ -20,6 +20,23 @@ from .utils.log import Log, LightGBMError
 from .utils.timer import global_timer
 
 
+_INIT_SCORE_CHUNK = 262_144  # rows densified at a time for sparse inputs
+
+
+def _init_score_predict(model: Booster, raw) -> np.ndarray:
+    """Raw-score predict for continued-training init scores. Sparse inputs
+    above the chunk size densify one row-chunk at a time (the full
+    `.toarray()` of a big sparse train matrix is exactly the transient the
+    streamed predict path exists to avoid)."""
+    if hasattr(raw, "toarray") and raw.shape[0] > _INIT_SCORE_CHUNK:
+        parts = []
+        for s in range(0, raw.shape[0], _INIT_SCORE_CHUNK):
+            dense = raw[s:s + _INIT_SCORE_CHUNK].toarray()
+            parts.append(np.atleast_1d(model.predict(dense, raw_score=True)))
+        return np.concatenate(parts, axis=0)
+    return model.predict(raw, raw_score=True)
+
+
 def train(params: Dict[str, Any], train_set: Dataset,
           num_boost_round: int = 100,
           valid_sets: Optional[List[Dataset]] = None,
@@ -56,7 +73,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
         raw = train_set._raw
         if raw is None:  # sparse train set: predict densifies per matrix
             raw = getattr(train_set, "_sparse_raw", None)
-        init_score = predictor_model.predict(raw, raw_score=True)
+        init_score = _init_score_predict(predictor_model, raw)
         train_set.set_init_score(np.asarray(init_score, dtype=np.float64).ravel(order="F"))
 
     booster = Booster(params=params, train_set=train_set)
@@ -78,7 +95,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 vraw = valid_data._raw
                 if vraw is None:
                     vraw = getattr(valid_data, "_sparse_raw", None)
-                vi = predictor_model.predict(vraw, raw_score=True)
+                vi = _init_score_predict(predictor_model, vraw)
                 valid_data.set_init_score(np.asarray(vi, dtype=np.float64).ravel(order="F"))
             booster.add_valid(valid_data, name)
 

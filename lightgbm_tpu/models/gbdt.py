@@ -22,7 +22,9 @@ from ..io.dataset import Dataset
 from ..metrics import create_metric
 from ..objectives import ObjectiveFunction
 from ..ops.partition import pad_indices
-from ..ops.predict import pack_ensemble, predict_raw
+from ..ops.predict import (PredictorCache, pack_ensemble, predict_dtype,
+                           predict_raw, predict_raw_streamed,
+                           stream_chunk_rows)
 from ..ops.score import add_tree_to_score
 from ..treelearner import create_tree_learner
 from ..utils.log import Log
@@ -119,7 +121,7 @@ class GBDT:
         self.class_need_train = [True] * self.num_tree_per_iteration
         if objective is not None and hasattr(objective, "class_need_train"):
             pass  # resolved after objective.init (below)
-        self._packed_cache = None
+        self._predictor = PredictorCache()
         self.valid_sets: List[_ValidData] = []
         self.valid_names: List[str] = []
         # async per-tree pipeline state (device learner only): the pending
@@ -265,7 +267,7 @@ class GBDT:
                     del self.models[i]
                     break
             self.iter_ -= 1
-            self._packed_cache = None
+            self._predictor.invalidate()
             self._async_stub_stop = True
             return
         tree.shrink(self.shrinkage_rate)
@@ -362,7 +364,7 @@ class GBDT:
                 else:
                     new_tree.as_constant_tree(0.0)
             self.models.append(new_tree)
-        self._packed_cache = None
+        self._predictor.invalidate()
         if not should_continue:
             Log.warning("Stopped training because there are no more leaves that "
                         "meet the split requirements")
@@ -393,7 +395,7 @@ class GBDT:
                 _colocate(pending.leaf_id, self.score),
                 jnp.float32(self.shrinkage_rate), self.config.num_leaves))
         self.models.append(pending.tree)
-        self._packed_cache = None
+        self._predictor.invalidate()
         self._flush_pending()  # overlaps t-1's replay with t's growth
         if self._async_stub_stop:
             self._async_stub_stop = False
@@ -527,35 +529,56 @@ class GBDT:
 
     # ---------------------------------------------------------------- predict
 
-    def _packed(self, num_iteration: int = 0, start_iteration: int = 0):
+    @staticmethod
+    def _sharded_predict_enabled(n_rows: int) -> bool:
+        from ..parallel.predict import sharded_predict_enabled
+
+        return sharded_predict_enabled(n_rows)
+
+    def _packed(self, num_iteration: int = 0, start_iteration: int = 0,
+                dtype=jnp.float32):
         self._flush_pending()
         C = self.num_tree_per_iteration
         start = max(start_iteration, 0) * C
         n_trees = len(self.models)
         if num_iteration > 0:
             n_trees = min(n_trees, start + num_iteration * C)
-        key = (start, n_trees)
-        if self._packed_cache is None or self._packed_cache[0] != key:
-            self._packed_cache = (key,
-                                  pack_ensemble(self.models[start:n_trees]))
-        return self._packed_cache[1]
+        return self._predictor.get(self.models, start, n_trees, dtype=dtype)
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 num_iteration: int = 0, start_iteration: int = 0,
-                early_stop: Optional[Tuple[int, float]] = None) -> np.ndarray:
-        packed = self._packed(num_iteration, start_iteration)
+                early_stop: Optional[Tuple[int, float]] = None,
+                chunk_rows: Optional[int] = None) -> np.ndarray:
+        dtype = predict_dtype(X)
+        packed = self._packed(num_iteration, start_iteration, dtype=dtype)
+        C = self.num_tree_per_iteration
+        n = X.shape[0]
+        chunk = stream_chunk_rows(n, chunk_rows)
         if early_stop is not None and packed.num_trees > 0:
             from ..ops.predict import predict_raw_early_stop
 
             freq, margin = early_stop
             out = predict_raw_early_stop(
-                packed, jnp.asarray(X, dtype=jnp.float32),
-                self.num_tree_per_iteration, freq, margin)
+                packed, jnp.asarray(X, dtype=dtype), C, freq, margin)
+        elif packed.num_trees > 0 and chunk_rows is not None and chunk > 0:
+            # explicit pred_chunk_rows wins over auto-sharding
+            out = predict_raw_streamed(
+                packed, np.asarray(X, dtype=np.dtype(dtype)), C, chunk, dtype)
+        elif packed.num_trees > 0 and not packed.linear \
+                and self._sharded_predict_enabled(n):
+            # linear ensembles keep single-chip dispatch: their score math
+            # runs eagerly for bit-stability (ops/predict.predict_raw)
+            from ..parallel.predict import predict_raw_sharded
+
+            out = predict_raw_sharded(
+                packed, np.asarray(X, dtype=np.dtype(dtype)), C)
+        elif chunk > 0 and packed.num_trees > 0:
+            out = predict_raw_streamed(
+                packed, np.asarray(X, dtype=np.dtype(dtype)), C, chunk, dtype)
         else:
-            out = predict_raw(packed, jnp.asarray(X, dtype=jnp.float32),
-                              self.num_tree_per_iteration)
+            out = predict_raw(packed, jnp.asarray(X, dtype=dtype), C)
         if self.average_output and packed.num_trees > 0:
-            out = out / (packed.num_trees // self.num_tree_per_iteration)
+            out = out / (packed.num_trees // C)
         if not raw_score and self.objective is not None:
             out = self.objective.convert_output(out)
         res = np.asarray(out)
@@ -565,8 +588,9 @@ class GBDT:
                            start_iteration: int = 0) -> np.ndarray:
         from ..ops.predict import predict_leaf_indices
 
-        packed = self._packed(num_iteration, start_iteration)
-        return np.asarray(predict_leaf_indices(packed, jnp.asarray(X, dtype=jnp.float32)))
+        dtype = predict_dtype(X)
+        packed = self._packed(num_iteration, start_iteration, dtype=dtype)
+        return np.asarray(predict_leaf_indices(packed, jnp.asarray(X, dtype=dtype)))
 
     # ------------------------------------------------------------------ model
 
@@ -614,7 +638,7 @@ class GBDT:
                         + (1.0 - decay) * out * tree.shrinkage)
                 lv = jnp.asarray(tree.leaf_value[:L], dtype=jnp.float32)
                 self.score = self.score.at[c].add(lv[leaf])
-        self._packed_cache = None
+        self._predictor.invalidate()
 
     def rollback_one_iter(self) -> None:
         """RollbackOneIter (gbdt.cpp:462): drop the last iteration's trees and
@@ -633,7 +657,7 @@ class GBDT:
             tree.shrink(-1.0)
         del self.models[-C:]
         self.iter_ -= 1
-        self._packed_cache = None
+        self._predictor.invalidate()
 
     def to_model(self) -> GBDTModel:
         self._flush_pending()

@@ -2,15 +2,30 @@
 
 TPU-native replacement for the reference's per-row recursive traversal
 (Tree::Predict / NumericalDecision, include/LightGBM/tree.h:338-420, and
-GBDT::PredictRaw, src/boosting/gbdt_prediction.cpp:15-56). Instead of
-pointer-chasing per row, all trees are packed into padded [T, nodes] tensors
-and traversed with a depth-synchronous gather loop under jit: every row of
-every tree advances one level per step; rows that reached a leaf (negative
-node id) freeze. This keeps shapes static and the whole ensemble evaluation a
-single fused XLA computation, vmapped over trees.
+GBDT::PredictRaw, src/boosting/gbdt_prediction.cpp:15-56). All trees are
+packed into padded [T, nodes] SoA tensors and traversed with ONE
+level-synchronous gather loop over the whole forest: every (row, tree)
+pair advances one level per step, rows that reached a leaf (negative node
+id) freeze, and each level issues a single X gather for all T trees (the
+per-tree formulation would issue T). Scores accumulate in-register — the
+[T, N] per-tree score matrix is never materialized.
+
+Serving-path machinery on top of the traversal:
+
+  * `PredictorCache` — packs the ensemble once per (model version, tree
+    slice, dtype) and keeps it device-resident across Booster.predict
+    calls; training/refit/rollback/model-load invalidate it.
+  * `predict_raw_streamed` — power-of-two row chunks with
+    copy_to_host_async double buffering for large N.
+  * `predict_raw_early_stop` — device-resident: scores and the active-row
+    mask stay on device; the only per-block host sync is one scalar.
+  * optional Pallas row-tile traversal behind LGBM_TPU_PREDICT_PALLAS=1
+    (ops/predict_pallas.py, interpret-tested like hist_pallas.py).
 """
 from __future__ import annotations
 
+import os
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from functools import partial
 from typing import List, Optional, Sequence
@@ -21,6 +36,8 @@ import numpy as np
 
 from ..common import MISSING_NAN, MISSING_ZERO, K_ZERO_THRESHOLD
 from ..models.tree import Tree
+from ..utils.log import Log
+from ..utils.timer import global_timer
 
 _EPS = K_ZERO_THRESHOLD
 
@@ -92,169 +109,410 @@ def pack_ensemble(trees: Sequence[Tree], dtype=jnp.float32,
     depth, keeping shapes stable across repeated packs (per-iteration
     validation scoring) so jit caches are reused.
     """
-    T = max(len(trees), 1)
-    I = max(max((t.num_leaves - 1 for t in trees), default=1), 1,
-            fixed_leaves - 1)
-    L = max(max((t.num_leaves for t in trees), default=1), 1, fixed_leaves)
-    sf = np.zeros((T, I), dtype=np.int32)
-    th = np.zeros((T, I), dtype=np.float64)
-    dt = np.zeros((T, I), dtype=np.int32)
-    lc = np.full((T, I), -1, dtype=np.int32)
-    rc = np.full((T, I), -1, dtype=np.int32)
-    lv = np.zeros((T, L), dtype=np.float64)
-    nl = np.ones(T, dtype=np.int32)
-    co = np.zeros((T, I), dtype=np.int32)
-    cw_n = np.zeros((T, I), dtype=np.int32)
-    cat_words: List[int] = []
-    max_depth = 1
-    for k, tree in enumerate(trees):
-        ni = tree.num_leaves - 1
-        nl[k] = tree.num_leaves
-        if ni > 0:
-            sf[k, :ni] = tree.split_feature[:ni]
-            th[k, :ni] = tree.threshold[:ni]
-            dt[k, :ni] = tree.decision_type[:ni].astype(np.int32) & 0xFF
-            lc[k, :ni] = tree.left_child[:ni]
-            rc[k, :ni] = tree.right_child[:ni]
-            max_depth = max(max_depth, tree.max_depth)
-            for node in range(ni):
-                if dt[k, node] & 1:  # categorical
-                    cat_idx = int(tree.threshold[node])
-                    lo, hi = tree.cat_boundaries[cat_idx], tree.cat_boundaries[cat_idx + 1]
-                    co[k, node] = len(cat_words)
-                    cw_n[k, node] = hi - lo
-                    cat_words.extend(tree.cat_threshold[lo:hi])
-        lv[k, : tree.num_leaves] = tree.leaf_value[: tree.num_leaves]
-    any_linear = any(t.is_linear for t in trees)
-    lin_const = lin_feat = lin_coeff = None
-    if any_linear:
-        K = max((len(t.leaf_features[i]) for t in trees if t.is_linear
-                 for i in range(t.num_leaves)), default=0)
-        lin_const = lv.copy()  # non-linear trees fall through to leaf_value
-        lin_feat = np.full((T, L, K), -1, dtype=np.int32)
-        lin_coeff = np.zeros((T, L, K), dtype=np.float64)
+    with global_timer.scope("predict_pack"):
+        T = max(len(trees), 1)
+        I = max(max((t.num_leaves - 1 for t in trees), default=1), 1,
+                fixed_leaves - 1)
+        L = max(max((t.num_leaves for t in trees), default=1), 1, fixed_leaves)
+        sf = np.zeros((T, I), dtype=np.int32)
+        th = np.zeros((T, I), dtype=np.float64)
+        dt = np.zeros((T, I), dtype=np.int32)
+        lc = np.full((T, I), -1, dtype=np.int32)
+        rc = np.full((T, I), -1, dtype=np.int32)
+        lv = np.zeros((T, L), dtype=np.float64)
+        nl = np.ones(T, dtype=np.int32)
+        co = np.zeros((T, I), dtype=np.int32)
+        cw_n = np.zeros((T, I), dtype=np.int32)
+        cat_words: List[int] = []
+        max_depth = 1
         for k, tree in enumerate(trees):
-            if not tree.is_linear or tree.leaf_const is None:
-                continue
-            lin_const[k, : tree.num_leaves] = tree.leaf_const[: tree.num_leaves]
-            for i in range(tree.num_leaves):
-                nf = len(tree.leaf_features[i])
-                if nf:
-                    lin_feat[k, i, :nf] = tree.leaf_features[i]
-                    lin_coeff[k, i, :nf] = tree.leaf_coeff[i]
-    if not cat_words:
-        cat_words = [0]
-    # float64 thresholds only take effect with jax x64 enabled; otherwise
-    # jnp.asarray would silently round-to-nearest down to f32, so route through
-    # the decision-preserving round-toward--inf downcast instead.
-    f64_effective = dtype == jnp.float64 and jax.config.jax_enable_x64
-    if not f64_effective:
-        # Round thresholds toward -inf when downcasting: for any float32 x,
-        # (x <= t64) == (x <= rounddown32(t64)), so device decisions over
-        # float32 inputs exactly match the float64 reference semantics.
-        th32 = th.astype(np.float32)
-        over = th32.astype(np.float64) > th
-        th32[over] = np.nextafter(th32[over], -np.inf)
-        th = th32
-    return PackedEnsemble(
-        split_feature=jnp.asarray(sf, dtype=jnp.int32),
-        threshold=jnp.asarray(th, dtype=jnp.float64 if f64_effective else jnp.float32),
-        decision_type=jnp.asarray(dt, dtype=jnp.int32),
-        left_child=jnp.asarray(lc, dtype=jnp.int32),
-        right_child=jnp.asarray(rc, dtype=jnp.int32),
-        leaf_value=jnp.asarray(lv, dtype=dtype),
-        cat_words=jnp.asarray(np.array(cat_words, dtype=np.uint32),
-                              dtype=jnp.uint32),
-        cat_offset=jnp.asarray(co, dtype=jnp.int32),
-        cat_n_words=jnp.asarray(cw_n, dtype=jnp.int32),
-        num_leaves=jnp.asarray(nl, dtype=jnp.int32),
-        max_depth=max(int(max_depth), fixed_depth),
-        num_trees=len(trees),
-        linear=any_linear,
-        lin_const=jnp.asarray(lin_const, dtype=dtype) if any_linear else None,
-        lin_feat=jnp.asarray(lin_feat, dtype=jnp.int32) if any_linear else None,
-        lin_coeff=jnp.asarray(lin_coeff, dtype=dtype) if any_linear else None,
-    )
+            ni = tree.num_leaves - 1
+            nl[k] = tree.num_leaves
+            if ni > 0:
+                sf[k, :ni] = tree.split_feature[:ni]
+                th[k, :ni] = tree.threshold[:ni]
+                dt[k, :ni] = tree.decision_type[:ni].astype(np.int32) & 0xFF
+                lc[k, :ni] = tree.left_child[:ni]
+                rc[k, :ni] = tree.right_child[:ni]
+                max_depth = max(max_depth, tree.max_depth)
+                for node in range(ni):
+                    if dt[k, node] & 1:  # categorical
+                        cat_idx = int(tree.threshold[node])
+                        lo, hi = tree.cat_boundaries[cat_idx], tree.cat_boundaries[cat_idx + 1]
+                        co[k, node] = len(cat_words)
+                        cw_n[k, node] = hi - lo
+                        cat_words.extend(tree.cat_threshold[lo:hi])
+            lv[k, : tree.num_leaves] = tree.leaf_value[: tree.num_leaves]
+        any_linear = any(t.is_linear for t in trees)
+        lin_const = lin_feat = lin_coeff = None
+        if any_linear:
+            K = max((len(t.leaf_features[i]) for t in trees if t.is_linear
+                     for i in range(t.num_leaves)), default=0)
+            lin_const = lv.copy()  # non-linear trees fall through to leaf_value
+            lin_feat = np.full((T, L, K), -1, dtype=np.int32)
+            lin_coeff = np.zeros((T, L, K), dtype=np.float64)
+            for k, tree in enumerate(trees):
+                if not tree.is_linear or tree.leaf_const is None:
+                    continue
+                lin_const[k, : tree.num_leaves] = tree.leaf_const[: tree.num_leaves]
+                for i in range(tree.num_leaves):
+                    nf = len(tree.leaf_features[i])
+                    if nf:
+                        lin_feat[k, i, :nf] = tree.leaf_features[i]
+                        lin_coeff[k, i, :nf] = tree.leaf_coeff[i]
+        if not cat_words:
+            cat_words = [0]
+        # float64 thresholds only take effect with jax x64 enabled; otherwise
+        # jnp.asarray would silently round-to-nearest down to f32, so route through
+        # the decision-preserving round-toward--inf downcast instead.
+        f64_effective = dtype == jnp.float64 and jax.config.jax_enable_x64
+        if not f64_effective:
+            # Round thresholds toward -inf when downcasting: for any float32 x,
+            # (x <= t64) == (x <= rounddown32(t64)), so device decisions over
+            # float32 inputs exactly match the float64 reference semantics.
+            th32 = th.astype(np.float32)
+            over = th32.astype(np.float64) > th
+            th32[over] = np.nextafter(th32[over], -np.inf)
+            th = th32
+        return PackedEnsemble(
+            split_feature=jnp.asarray(sf, dtype=jnp.int32),
+            threshold=jnp.asarray(th, dtype=jnp.float64 if f64_effective else jnp.float32),
+            decision_type=jnp.asarray(dt, dtype=jnp.int32),
+            left_child=jnp.asarray(lc, dtype=jnp.int32),
+            right_child=jnp.asarray(rc, dtype=jnp.int32),
+            leaf_value=jnp.asarray(lv, dtype=dtype),
+            cat_words=jnp.asarray(np.array(cat_words, dtype=np.uint32),
+                                  dtype=jnp.uint32),
+            cat_offset=jnp.asarray(co, dtype=jnp.int32),
+            cat_n_words=jnp.asarray(cw_n, dtype=jnp.int32),
+            num_leaves=jnp.asarray(nl, dtype=jnp.int32),
+            max_depth=max(int(max_depth), fixed_depth),
+            num_trees=len(trees),
+            linear=any_linear,
+            lin_const=jnp.asarray(lin_const, dtype=dtype) if any_linear else None,
+            lin_feat=jnp.asarray(lin_feat, dtype=jnp.int32) if any_linear else None,
+            lin_coeff=jnp.asarray(lin_coeff, dtype=dtype) if any_linear else None,
+        )
 
 
-def _tree_leaf_index(packed: PackedEnsemble, tree_idx, X: jax.Array, max_depth: int):
-    """Leaf index [N] for one tree over row-major X [N, F]."""
-    sf = packed.split_feature[tree_idx]
-    th = packed.threshold[tree_idx]
-    dt = packed.decision_type[tree_idx]
-    lc = packed.left_child[tree_idx]
-    rc = packed.right_child[tree_idx]
-    co = packed.cat_offset[tree_idx]
-    cn = packed.cat_n_words[tree_idx]
+def predict_dtype(X):
+    """Device dtype for a predict input: f64 inputs keep f64 when jax x64
+    is enabled (models whose thresholds need the full mantissa); everything
+    else runs f32 — safe because pack_ensemble's round-toward--inf
+    threshold downcast keeps f32 decisions identical to the f64 reference."""
+    if getattr(X, "dtype", None) == np.float64 and jax.config.jax_enable_x64:
+        return jnp.float64
+    return jnp.float32
+
+
+# --------------------------------------------------------------- traversal
+
+
+def forest_level_step(X: jax.Array, node: jax.Array, sf: jax.Array,
+                      th: jax.Array, dt: jax.Array, lc: jax.Array,
+                      rc: jax.Array, co: jax.Array, cn: jax.Array,
+                      cat_words: jax.Array) -> jax.Array:
+    """Advance every (row, tree) pair one level: node [N, T] -> [N, T].
+
+    Node attributes for ALL T trees' current nodes gather from the
+    flattened [T*I] tables in one shot, and the feature values for the
+    whole forest come from ONE take_along_axis over X — the per-tree
+    formulation issued T X-gathers per level. Shared verbatim by the XLA
+    path and the Pallas row-tile kernel (ops/predict_pallas.py)."""
+    I = sf.shape[1]
+    T = sf.shape[0]
+    tree_base = jnp.arange(T, dtype=jnp.int32)[None, :] * I
+    active = node >= 0
+    nd = tree_base + jnp.maximum(node, 0)  # flat [N, T] into [T*I] tables
+    feat = sf.reshape(-1)[nd]
+    d = dt.reshape(-1)[nd]
+    fval = jnp.take_along_axis(X, feat, axis=1)  # ONE X gather per level
+    is_cat = (d & 1) > 0
+    default_left = (d & 2) > 0
+    missing_type = (d >> 2) & 3
+    # --- numerical decision (tree.h:338-355)
+    is_nan = jnp.isnan(fval)
+    fval_num = jnp.where(is_nan & (missing_type != MISSING_NAN), 0.0, fval)
+    is_missing = ((missing_type == MISSING_ZERO) & (jnp.abs(fval_num) <= _EPS)) | (
+        (missing_type == MISSING_NAN) & jnp.isnan(fval_num))
+    go_left_num = jnp.where(is_missing, default_left,
+                            fval_num <= th.reshape(-1)[nd])
+    # --- categorical decision (tree.h:375-388)
+    int_fval = jnp.where(is_nan, -1, fval.astype(jnp.int32))
+    word_idx = jnp.clip(int_fval, 0, None) // 32
+    bit_idx = jnp.clip(int_fval, 0, None) % 32
+    in_range = (int_fval >= 0) & (word_idx < cn.reshape(-1)[nd])
+    word = cat_words[jnp.clip(co.reshape(-1)[nd] + word_idx, 0,
+                              cat_words.shape[0] - 1)]
+    go_left_cat = in_range & (((word >> bit_idx.astype(jnp.uint32)) & 1) > 0)
+    go_left = jnp.where(is_cat, go_left_cat, go_left_num)
+    nxt = jnp.where(go_left, lc.reshape(-1)[nd], rc.reshape(-1)[nd])
+    return jnp.where(active, nxt, node)
+
+
+def _traverse_leaves(packed: PackedEnsemble, X: jax.Array) -> jax.Array:
+    """[N, T] leaf index per row per tree, level-synchronous over the
+    whole forest."""
     n = X.shape[0]
-    single_leaf = packed.num_leaves[tree_idx] <= 1
+    T = packed.split_feature.shape[0]
+    node0 = jnp.zeros((n, T), dtype=jnp.int32)
 
     def body(_, node):
-        active = node >= 0
-        nd = jnp.maximum(node, 0)
-        feat = sf[nd]
-        fval = jnp.take_along_axis(X, feat[:, None], axis=1)[:, 0]
-        d = dt[nd]
-        is_cat = (d & 1) > 0
-        default_left = (d & 2) > 0
-        missing_type = (d >> 2) & 3
-        # --- numerical decision (tree.h:338-355)
-        is_nan = jnp.isnan(fval)
-        fval_num = jnp.where(is_nan & (missing_type != MISSING_NAN), 0.0, fval)
-        is_missing = ((missing_type == MISSING_ZERO) & (jnp.abs(fval_num) <= _EPS)) | (
-            (missing_type == MISSING_NAN) & jnp.isnan(fval_num))
-        go_left_num = jnp.where(is_missing, default_left, fval_num <= th[nd])
-        # --- categorical decision (tree.h:375-388)
-        int_fval = jnp.where(is_nan, -1, fval.astype(jnp.int32))
-        word_idx = jnp.clip(int_fval, 0, None) // 32
-        bit_idx = jnp.clip(int_fval, 0, None) % 32
-        in_range = (int_fval >= 0) & (word_idx < cn[nd])
-        word = packed.cat_words[jnp.clip(co[nd] + word_idx, 0, packed.cat_words.shape[0] - 1)]
-        go_left_cat = in_range & (((word >> bit_idx.astype(jnp.uint32)) & 1) > 0)
-        go_left = jnp.where(is_cat, go_left_cat, go_left_num)
-        nxt = jnp.where(go_left, lc[nd], rc[nd])
-        return jnp.where(active, nxt, node)
+        return forest_level_step(
+            X, node, packed.split_feature, packed.threshold,
+            packed.decision_type, packed.left_child, packed.right_child,
+            packed.cat_offset, packed.cat_n_words, packed.cat_words)
 
-    node0 = jnp.zeros(n, dtype=jnp.int32)
-    node = jax.lax.fori_loop(0, max_depth, body, node0)
-    leaf = jnp.where(single_leaf, 0, ~node)
-    return leaf
+    node = jax.lax.fori_loop(0, packed.max_depth, body, node0)
+    # a leaf id is the bitwise complement of the (negative) frozen node;
+    # single-leaf (constant) trees sit at leaf 0
+    return jnp.where(packed.num_leaves[None, :] <= 1, 0, ~node)
+
+
+def _leaf_scores(packed: PackedEnsemble, X: jax.Array,
+                 leaf: jax.Array) -> jax.Array:
+    """Per-(row, tree) scores [N, T] from leaf assignments. Linear-tree
+    ensembles evaluate const + coeffs . raw features, falling back to the
+    constant leaf value when any model feature is NaN/inf
+    (Tree::PredictByMap linear path, src/io/tree.cpp) — vectorized across
+    trees with one [N, T*K] X gather."""
+    T, L = packed.leaf_value.shape
+    flat = jnp.arange(T, dtype=jnp.int32)[None, :] * L + leaf  # [N, T]
+    base = packed.leaf_value.reshape(-1)[flat]
+    if not packed.linear:
+        return base
+    n = X.shape[0]
+    K = packed.lin_feat.shape[2]
+    feats = packed.lin_feat.reshape(T * L, K)[flat]  # [N, T, K]
+    used = feats >= 0
+    fv = jnp.take_along_axis(
+        X, jnp.clip(feats, 0, X.shape[1] - 1).reshape(n, T * K),
+        axis=1).reshape(n, T, K)
+    bad = (used & ~jnp.isfinite(fv)).any(axis=2)
+    fv = jnp.where(used, fv, 0.0)
+    lin = packed.lin_const.reshape(-1)[flat] + jnp.where(
+        used, packed.lin_coeff.reshape(T * L, K)[flat] * fv, 0.0).sum(axis=2)
+    return jnp.where(bad, base, lin)
+
+
+@partial(jax.jit, static_argnames=("num_tree_per_iteration",))
+def _predict_raw_fused(packed: PackedEnsemble, X: jax.Array,
+                       num_tree_per_iteration: int) -> jax.Array:
+    """Fused traverse + score + per-class accumulate: [N, C] without ever
+    materializing the [T, N] per-tree score matrix."""
+    leaf = _traverse_leaves(packed, X)
+    vals = _leaf_scores(packed, X, leaf)
+    n, T = vals.shape
+    return vals.reshape(n, T // num_tree_per_iteration,
+                        num_tree_per_iteration).sum(axis=1)
+
+
+_leaf_indices_fused = jax.jit(_traverse_leaves)
 
 
 def predict_leaf_indices(packed: PackedEnsemble, X: jax.Array) -> jax.Array:
     """[N, T] leaf index per row per tree."""
-    T = packed.num_trees
-    leaf_fn = jax.vmap(lambda k: _tree_leaf_index(packed, k, X, packed.max_depth))
-    return leaf_fn(jnp.arange(T, dtype=jnp.int32)).T
+    if packed.num_trees == 0:
+        return jnp.zeros((X.shape[0], 0), dtype=jnp.int32)
+    with global_timer.scope("predict_traverse"):
+        return _leaf_indices_fused(packed, X)
 
 
-def predict_raw(packed: PackedEnsemble, X: jax.Array, num_tree_per_iteration: int = 1) -> jax.Array:
+def validate_tree_count(packed: PackedEnsemble,
+                        num_tree_per_iteration: int) -> None:
+    """The packed tree count must cover whole iterations: a ragged slice
+    would mis-assign trees to classes in the per-class accumulate."""
+    if num_tree_per_iteration > 0 \
+            and packed.num_trees % num_tree_per_iteration != 0:
+        Log.fatal(
+            "Cannot predict with %d trees grouped %d per iteration: the "
+            "slice does not cover whole iterations (check num_iteration / "
+            "start_iteration against the model's tree count)",
+            packed.num_trees, num_tree_per_iteration)
+
+
+def predict_pallas_enabled() -> bool:
+    return os.environ.get("LGBM_TPU_PREDICT_PALLAS", "").lower() in (
+        "1", "true", "on")
+
+
+def predict_raw(packed: PackedEnsemble, X: jax.Array,
+                num_tree_per_iteration: int = 1) -> jax.Array:
     """Raw scores [N, num_tree_per_iteration] summed over iterations."""
     T = packed.num_trees
     if T == 0:
         return jnp.zeros((X.shape[0], num_tree_per_iteration), dtype=X.dtype)
+    validate_tree_count(packed, num_tree_per_iteration)
+    if predict_pallas_enabled() and not packed.linear:
+        from .predict_pallas import pallas_predict_raw
 
-    def tree_score(k):
-        leaf = _tree_leaf_index(packed, k, X, packed.max_depth)
-        base = packed.leaf_value[k][leaf]
-        if not packed.linear:
-            return base
-        # linear leaf output: const + coeffs . raw features, falling back to
-        # the constant leaf value when any model feature is NaN/inf
-        # (Tree::PredictByMap linear path, src/io/tree.cpp)
-        feats = packed.lin_feat[k][leaf]  # [N, K]
-        used = feats >= 0
-        fv = jnp.take_along_axis(
-            X, jnp.clip(feats, 0, X.shape[1] - 1), axis=1)
-        bad = (used & ~jnp.isfinite(fv)).any(axis=1)
-        fv = jnp.where(used, fv, 0.0)
-        lin = packed.lin_const[k][leaf] + jnp.where(
-            used, packed.lin_coeff[k][leaf] * fv, 0.0).sum(axis=1)
-        return jnp.where(bad, base, lin)
+        # Mosaic compiles on TPU only; elsewhere (CPU tests, GPU) the
+        # opt-in still works end to end through interpret mode
+        interp = jax.default_backend() != "tpu"
+        with global_timer.scope("predict_traverse"):
+            return pallas_predict_raw(packed, X, num_tree_per_iteration,
+                                      interpret=interp)
+    with global_timer.scope("predict_traverse"):
+        if packed.linear:
+            # under jit XLA contracts the linear mul+sum into fmas, a 1-ulp
+            # drift vs the eager reference arithmetic; keep the score math
+            # eager (the traversal is integer-only and stays jitted)
+            leaf = _leaf_indices_fused(packed, X)
+            vals = _leaf_scores(packed, X, leaf)
+            n, T = vals.shape
+            return vals.reshape(n, T // num_tree_per_iteration,
+                                num_tree_per_iteration).sum(axis=1)
+        return _predict_raw_fused(packed, X, num_tree_per_iteration)
 
-    scores = jax.vmap(tree_score)(jnp.arange(T, dtype=jnp.int32))  # [T, N]
-    scores = scores.reshape(T // num_tree_per_iteration, num_tree_per_iteration, X.shape[0])
-    return scores.sum(axis=0).T  # [N, C]
+
+# ------------------------------------------------------------------- cache
+
+
+class PredictorCache:
+    """Device-resident PackedEnsemble cache for the serving path.
+
+    Repeated Booster.predict calls reuse the packed arrays already on
+    device instead of re-packing and re-uploading the ensemble per call.
+    Keys are (model version, tree slice, dtype); any mutation of the model
+    list — training an iteration, refit, rollback, loading a model — must
+    call invalidate(), which bumps the version and drops every entry. A
+    small LRU bound keeps sliced predicts (num_iteration / staged CV
+    evaluation) from pinning unbounded HBM."""
+
+    def __init__(self, capacity: int = 4) -> None:
+        self.capacity = capacity
+        self._version = 0
+        self._entries: "OrderedDict[tuple, PackedEnsemble]" = OrderedDict()
+
+    def invalidate(self) -> None:
+        self._version += 1
+        self._entries.clear()
+
+    def get(self, trees: Sequence[Tree], start: int, end: int,
+            dtype=jnp.float32) -> PackedEnsemble:
+        key = (self._version, start, end, np.dtype(dtype).name)
+        hit = self._entries.get(key)
+        if hit is not None:
+            self._entries.move_to_end(key)
+            global_timer.add_count("predict_pack_hits", 1)
+            return hit
+        packed = pack_ensemble(trees[start:end], dtype=dtype)
+        self._entries[key] = packed
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+        return packed
+
+
+# --------------------------------------------------------------- streaming
+
+_CHUNK_ENV = "LGBM_TPU_PREDICT_CHUNK"
+_AUTO_CHUNK_ROWS = 1 << 18       # 256k-row device chunks
+_AUTO_STREAM_MIN_ROWS = 1 << 19  # stream once the batch is >= two chunks
+
+
+def stream_chunk_rows(n_rows: int, requested: Optional[int] = None) -> int:
+    """Row-chunk size for streamed predict; 0 means run single-shot.
+
+    `requested` (the pred_chunk_rows param) wins; then the
+    LGBM_TPU_PREDICT_CHUNK env var; then auto (256k chunks once the batch
+    is at least two of them). Chunks round up to a power of two
+    (ops/partition.bucket_size) so the jit cache holds one traversal per
+    bucket, not one per batch size."""
+    from .partition import bucket_size
+
+    chunk = requested
+    if chunk is None:
+        env = os.environ.get(_CHUNK_ENV, "")
+        if env:
+            try:
+                chunk = int(env)
+            except ValueError:
+                chunk = None
+        if chunk is None:
+            chunk = _AUTO_CHUNK_ROWS if n_rows >= _AUTO_STREAM_MIN_ROWS else 0
+    if chunk <= 0 or n_rows <= chunk:
+        return 0
+    return bucket_size(chunk, 256)
+
+
+def predict_raw_streamed(packed: PackedEnsemble, X: np.ndarray,
+                         num_tree_per_iteration: int, chunk: int,
+                         dtype) -> np.ndarray:
+    """Chunked double-buffered raw predict for large N, on host arrays.
+
+    Each chunk uploads, traverses, and starts its device->host copy
+    (copy_to_host_async) before the next chunk is touched, so H2D,
+    compute, and D2H overlap; the host blocks only when more than two
+    results are in flight. The tail chunk pads to its own power-of-two
+    bucket (bounded jit cache). Returns a host [N, C] array."""
+    from .partition import bucket_size
+
+    validate_tree_count(packed, num_tree_per_iteration)
+    n = X.shape[0]
+    n_chunks = -(-n // chunk)
+    out_parts: List[Optional[np.ndarray]] = [None] * n_chunks
+    inflight: deque = deque()
+    with global_timer.scope("predict_stream"):
+        for i in range(n_chunks):
+            start = i * chunk
+            stop = min(start + chunk, n)
+            rows = stop - start
+            xc = X[start:stop]
+            pad = chunk if rows == chunk else bucket_size(rows, 256)
+            if rows < pad:  # tail chunk: pad to its own bucket
+                xc = np.concatenate(
+                    [xc, np.zeros((pad - rows, X.shape[1]), dtype=X.dtype)])
+            xd = jnp.asarray(xc, dtype=dtype)
+            yd = predict_raw(packed, xd, num_tree_per_iteration)
+            yd.copy_to_host_async()
+            inflight.append((i, rows, yd))
+            while len(inflight) > 2:
+                j, r, y = inflight.popleft()
+                out_parts[j] = np.asarray(y)[:r]
+        while inflight:
+            j, r, y = inflight.popleft()
+            out_parts[j] = np.asarray(y)[:r]
+        global_timer.add_count("predict_stream_chunks", n_chunks)
+    return np.concatenate(out_parts, axis=0)
+
+
+# -------------------------------------------------------------- early stop
+
+
+@partial(jax.jit, static_argnames=("bucket",))
+def _compact_active(active: jax.Array, bucket: int) -> jax.Array:
+    """Indices of active rows first (stable argsort over the 2-way key —
+    the ops/partition compaction idiom), truncated to `bucket`."""
+    key = jnp.where(active, 0, 1).astype(jnp.int32)
+    return jnp.argsort(key).astype(jnp.int32)[:bucket]
+
+
+@partial(jax.jit, static_argnames=("num_tree_per_iteration",))
+def _early_stop_block(packed_sl: PackedEnsemble, X: jax.Array,
+                      scores: jax.Array, active: jax.Array, idx: jax.Array,
+                      cnt: jax.Array, margin: jax.Array,
+                      num_tree_per_iteration: int):
+    """One tree block of device-resident early stopping: gather the
+    still-active rows, add the block's raw scores, and deactivate rows
+    whose margin clears the threshold — all without leaving the device."""
+    C = num_tree_per_iteration
+    P = idx.shape[0]
+    valid = jnp.arange(P, dtype=jnp.int32) < cnt  # rows past cnt are padding
+    Xa = X[idx]
+    leaf = _traverse_leaves(packed_sl, Xa)
+    delta = _leaf_scores(packed_sl, Xa, leaf).reshape(P, -1, C).sum(axis=1)
+    scores = scores.at[idx].add(
+        jnp.where(valid[:, None], delta, jnp.zeros((), delta.dtype)))
+    sc = scores[idx]
+    if C == 1:
+        # binary margin is 2*|pred| (prediction_early_stop.cpp:65)
+        margin_val = 2.0 * jnp.abs(sc[:, 0])
+    else:
+        top2 = jax.lax.top_k(sc, 2)[0]
+        margin_val = top2[:, 0] - top2[:, 1]
+    stop = (margin_val > margin) & valid
+    active = active.at[idx].set(active[idx] & ~stop)
+    return scores, active
 
 
 def predict_raw_early_stop(packed: PackedEnsemble, X: jax.Array,
@@ -265,37 +523,36 @@ def predict_raw_early_stop(packed: PackedEnsemble, X: jax.Array,
     iterations, rows whose margin — |score| for binary, top-2 class gap for
     multiclass — exceeds `margin_threshold` stop traversing further trees.
 
-    TPU formulation: the reference's per-row sequential check becomes
-    host-chunked batches — still-active rows are compacted (power-of-two
-    padded so jit caches stay bounded) and only they evaluate the next tree
-    block. Batch workloads with confident rows skip most of the ensemble.
+    Device-resident formulation: the score matrix and the active-row mask
+    live on device; per block the still-active rows are compacted by a
+    stable argsort (power-of-two padded so jit caches stay bounded) and
+    only they evaluate the next tree block. The ONLY host sync per block
+    is the active-count scalar that picks the bucket size — the previous
+    implementation pulled the whole per-block delta matrix through
+    np.asarray and recomputed the compaction with np.nonzero on host.
     """
     from .partition import bucket_size
 
     C = num_tree_per_iteration
     T = packed.num_trees
+    validate_tree_count(packed, C)
     N = X.shape[0]
-    out = np.zeros((N, C), dtype=np.float64)
-    active = np.ones(N, dtype=bool)
+    # graftlint: disable=implicit-dtype -- X keeps its caller dtype (f32 or f64)
+    X_dev = jnp.asarray(X)
+    scores = jnp.zeros((N, C), dtype=packed.leaf_value.dtype)
+    active = jnp.ones(N, dtype=jnp.bool_)
     block = max(round_period, 1) * C
-    for start in range(0, T, block):
-        idx = np.nonzero(active)[0]
-        if idx.size == 0:
-            break
-        pad = bucket_size(idx.size, 256)
-        idx_pad = np.zeros(pad, dtype=np.int64)
-        idx_pad[: idx.size] = idx
-        # graftlint: disable=implicit-dtype -- X keeps its caller dtype (f32 or f64)
-        Xa = jnp.asarray(X)[jnp.asarray(idx_pad, dtype=jnp.int32)]
-        sl = packed.tree_slice(start, min(start + block, T))
-        delta = np.asarray(predict_raw(sl, Xa, C))[: idx.size]
-        out[idx] += delta
-        scores = out[idx]
-        if C == 1:
-            # binary margin is 2*|pred| (prediction_early_stop.cpp:65)
-            stop = 2.0 * np.abs(scores[:, 0]) > margin_threshold
-        else:
-            top2 = np.partition(scores, -2, axis=1)[:, -2:]
-            stop = (top2[:, 1] - top2[:, 0]) > margin_threshold
-        active[idx[stop]] = False
-    return out
+    with global_timer.scope("predict_early_stop"):
+        for start in range(0, T, block):
+            # the one intended sync per block: a scalar count picks the
+            # power-of-two bucket, keeping compiled shapes bounded
+            cnt_dev = jnp.sum(active, dtype=jnp.int32)
+            cnt = int(cnt_dev)
+            if cnt == 0:
+                break
+            bucket = min(bucket_size(cnt, 256), N)
+            idx = _compact_active(active, bucket)
+            sl = packed.tree_slice(start, min(start + block, T))
+            scores, active = _early_stop_block(
+                sl, X_dev, scores, active, idx, cnt_dev, margin_threshold, C)
+    return np.asarray(scores, dtype=np.float64)

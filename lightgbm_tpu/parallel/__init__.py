@@ -19,8 +19,10 @@ linker plumbing are XLA's job. The three reference parallel learners map to:
 from .learners import (DataParallelTreeLearner, FeatureParallelTreeLearner,
                        VotingParallelTreeLearner, create_parallel_learner)
 from .mesh import data_mesh
+from .predict import predict_raw_sharded, sharded_predict_enabled
 
 __all__ = [
     "DataParallelTreeLearner", "FeatureParallelTreeLearner",
     "VotingParallelTreeLearner", "create_parallel_learner", "data_mesh",
+    "predict_raw_sharded", "sharded_predict_enabled",
 ]

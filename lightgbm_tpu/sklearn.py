@@ -188,7 +188,12 @@ class LGBMModel(BaseEstimator):
         if self._Booster is None:
             raise _not_fitted_error(self)
         if not _is_dataframe(X):  # frames map through pandas_categorical
-            X = np.asarray(X, dtype=np.float64)
+            # keep f32/f64 inputs as-is: Booster.predict routes the device
+            # dtype, and an f32 matrix forced through f64 would pay a 2x
+            # host copy just to be downcast again at upload
+            X = np.asarray(X)
+            if X.dtype not in (np.float32, np.float64):
+                X = X.astype(np.float64)
         if X.shape[1] != self._n_features:
             raise ValueError(
                 "Number of features of the model must match the input. "

@@ -1,0 +1,55 @@
+"""R5 fixture: the serving hot path ops/predict.py is in scope_exact —
+a >50-line pack helper with no timer reference must fire."""
+
+
+def big_untimed_pack(trees):
+    tables = []
+    total_nodes = 0
+    total_leaves = 0
+    max_depth = 0
+    for tree in trees:
+        n_leaves = tree["num_leaves"]
+        n_internal = n_leaves - 1
+        total_nodes += n_internal
+        total_leaves += n_leaves
+        if tree["depth"] > max_depth:
+            max_depth = tree["depth"]
+        features = []
+        thresholds = []
+        lefts = []
+        rights = []
+        for node in range(n_internal):
+            features.append(tree["split_feature"][node])
+            thresholds.append(tree["threshold"][node])
+            lefts.append(tree["left"][node])
+            rights.append(tree["right"][node])
+        while len(features) < 31:
+            features.append(0)
+            thresholds.append(0.0)
+            lefts.append(-1)
+            rights.append(-1)
+        values = []
+        for leaf in range(n_leaves):
+            values.append(tree["leaf_value"][leaf])
+        while len(values) < 32:
+            values.append(0.0)
+        tables.append({
+            "features": features,
+            "thresholds": thresholds,
+            "lefts": lefts,
+            "rights": rights,
+            "values": values,
+        })
+    summary = {
+        "n_trees": len(trees),
+        "total_nodes": total_nodes,
+        "total_leaves": total_leaves,
+        "max_depth": max_depth,
+    }
+    padded = []
+    for table in tables:
+        row = []
+        for key in ("features", "thresholds", "lefts", "rights", "values"):
+            row.extend(table[key])
+        padded.append(row)
+    return summary, padded

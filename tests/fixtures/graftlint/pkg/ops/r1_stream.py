@@ -1,0 +1,37 @@
+"""R1 fixture: per-iteration host syncs on fresh dispatches in loops."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def traverse(x):
+    return x * 2
+
+
+def predict_block(x):
+    return traverse(x)
+
+
+def stream_loop(xs):
+    out = 0.0
+    for x in xs:
+        out += np.asarray(predict_block(x)).sum()  # line 18: VIOLATION
+    return out
+
+
+def buffered_loop(xs):
+    acc = []
+    total = 0
+    for x in xs:
+        acc.append(predict_block(x))
+    for y in acc:
+        total += np.asarray(y).sum()  # pull of a prior dispatch: clean
+    return total
+
+
+def gated_loop(xs):
+    total = 0
+    for x in xs:
+        # graftlint: disable=jit-host-sync -- fixture: tiny scalar pull each round by contract
+        total += int(traverse(x).sum())  # suppressed
+    return total

@@ -44,3 +44,7 @@ def test_bench_smoke_cpu():
     # np_rows * (32*4 + 20) — assert we sit in the narrow-plane regime.
     n_pad = -(-20000 // 1024) * 1024
     assert record["est_carried_bytes_per_wave"] == n_pad * (32 + 20)
+    # inference metric: chunked streaming predict must have run and timed.
+    # 20000 rows -> chunk = bucket_size(5000, 1024) = 8192 (3 chunks).
+    assert record["predict_rows_per_sec"] > 0
+    assert record["predict_chunk_rows"] == 8192

@@ -51,6 +51,23 @@ def test_r1_suppression_honored(fixture_result):
     assert "host-side by contract" in sup[0].reason
 
 
+def test_r1_loop_sync_on_fresh_dispatch(fixture_result):
+    # np.asarray(predict_block(x)) per loop iteration — the pre-rewrite
+    # predict_raw_early_stop shape — must fire with the pipeline message
+    bad = _hits(fixture_result, "jit-host-sync", "ops/r1_stream.py")
+    assert [v.line for v in bad] == [18]
+    assert "serializes the dispatch pipeline" in bad[0].message
+
+
+def test_r1_loop_sync_buffered_and_suppressed(fixture_result):
+    # pulling a PREVIOUSLY dispatched value (bare name, double-buffer
+    # drain) is clean; the reasoned suppression is honored
+    sup = _hits(fixture_result, "jit-host-sync", "ops/r1_stream.py",
+                suppressed=True)
+    assert [v.line for v in sup] == [36]
+    assert "tiny scalar pull" in sup[0].reason
+
+
 # -- R2 dtype discipline --------------------------------------------------
 
 def test_r2_detects_implicit_dtype(fixture_result):
@@ -126,6 +143,13 @@ def test_r5_timed_and_jitted_exempt(fixture_result):
             fixture_result.violations + fixture_result.suppressed]
     assert not any("'big_timed'" in m for m in msgs)
     assert not any("'big_jitted'" in m for m in msgs)
+
+
+def test_r5_scope_covers_serving_hot_path(fixture_result):
+    # ops/predict.py joined the R5 scope (scope_exact): the untimed pack
+    # helper fixture must fire there too
+    bad = _hits(fixture_result, "untimed-hot-func", "ops/predict.py")
+    assert len(bad) == 1 and "'big_untimed_pack'" in bad[0].message
 
 
 def test_r5_suppression_honored(fixture_result):

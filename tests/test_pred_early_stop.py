@@ -1,7 +1,35 @@
 """Prediction early-stop tests (src/boosting/prediction_early_stop.cpp)."""
+import jax.numpy as jnp
 import numpy as np
 
 import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.predict import pack_ensemble, predict_raw_early_stop
+
+
+def _early_stop_reference(trees, X, C, freq, margin):
+    """Host sequential early stop: per block of freq*C trees, each active
+    row adds tree m's output to class m % C, then stops once its margin
+    (2|s| binary, top-2 gap multiclass) clears the threshold."""
+    N = X.shape[0]
+    out = np.zeros((N, C), dtype=np.float64)
+    active = np.ones(N, dtype=bool)
+    block = max(freq, 1) * C
+    for start in range(0, len(trees), block):
+        if not active.any():
+            break
+        for m in range(start, min(start + block, len(trees))):
+            t = trees[m]
+            for i in np.nonzero(active)[0]:
+                out[i, m % C] += t.predict(X[i])
+        for i in np.nonzero(active)[0]:
+            if C == 1:
+                mg = 2.0 * abs(out[i, 0])
+            else:
+                top = np.sort(out[i])[-2:]
+                mg = top[1] - top[0]
+            if mg > margin:
+                active[i] = False
+    return out
 
 
 def test_binary_early_stop_margin(rng):
@@ -36,3 +64,59 @@ def test_multiclass_early_stop(rng):
     es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
                      pred_early_stop_margin=3.0)
     assert np.mean(es.argmax(axis=1) == full.argmax(axis=1)) > 0.99
+
+
+# ------------------- device path vs host sequential reference equivalence
+
+def test_binary_early_stop_matches_host_reference(rng):
+    n = 300
+    X = rng.randn(n, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "learning_rate": 0.3, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=20)
+    got = bst.predict(X, raw_score=True, pred_early_stop=True,
+                      pred_early_stop_freq=4, pred_early_stop_margin=1.5)
+    ref = _early_stop_reference(bst._gbdt.models, X, 1, 4, 1.5)[:, 0]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_multiclass_early_stop_matches_host_reference(rng):
+    n = 250
+    X = rng.randn(n, 4)
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)).astype(float)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "learning_rate": 0.3, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=12)
+    got = bst.predict(X, raw_score=True, pred_early_stop=True,
+                      pred_early_stop_freq=3, pred_early_stop_margin=1.0)
+    ref = _early_stop_reference(bst._gbdt.models, X, 3, 3, 1.0)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_early_stop_categorical_nan_ensemble():
+    from tests.test_predict_op import _nan_cat_tree
+
+    # the same cat+NaN tree across 6 blocks: block semantics + the device
+    # categorical/missing decisions must match the host walk exactly
+    trees = [_nan_cat_tree() for _ in range(6)]
+    X = np.array([[np.nan, 0.0], [1.0, 1.0], [1.0, 2.0], [1.0, 3.0],
+                  [1.0, np.nan], [0.2, 1.5]], dtype=np.float64)
+    packed = pack_ensemble(trees)
+    got = predict_raw_early_stop(packed, jnp.asarray(X, dtype=jnp.float32),
+                                 1, 2, 9.0)
+    ref = _early_stop_reference(trees, X, 1, 2, 9.0)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_early_stop_linear_tree_ensemble(rng):
+    n = 200
+    X = rng.rand(n, 3)
+    y = 2.0 * X[:, 0] - X[:, 1] + 0.05 * rng.randn(n)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "linear_tree": True, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    got = bst.predict(X, raw_score=True, pred_early_stop=True,
+                      pred_early_stop_freq=3, pred_early_stop_margin=2.0)
+    ref = _early_stop_reference(bst._gbdt.models, X, 1, 3, 2.0)[:, 0]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

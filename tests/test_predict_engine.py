@@ -1,0 +1,149 @@
+"""Serving-path engine tests: PredictorCache reuse/invalidation, chunked
+streaming, and row-sharded predict on the 8 fake CPU devices."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _train_binary(rng, n=600, rounds=8):
+    X = rng.randn(n, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=rounds)
+    return bst, X, y
+
+
+def test_repeated_predict_does_not_repack(rng, monkeypatch):
+    import lightgbm_tpu.ops.predict as pred_mod
+
+    bst, X, _ = _train_binary(rng)
+    first = bst.predict(X)  # populates the cache
+
+    calls = {"n": 0}
+    real = pred_mod.pack_ensemble
+
+    def counting(*args, **kwargs):
+        calls["n"] += 1
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(pred_mod, "pack_ensemble", counting)
+    second = bst.predict(X)
+    assert calls["n"] == 0  # device-resident ensemble reused, zero repacks
+    np.testing.assert_array_equal(first, second)
+    # leaf-index predict shares the same cache entry
+    bst.predict(X, pred_leaf=True)
+    assert calls["n"] == 0
+
+
+def test_cache_invalidated_by_training(rng):
+    bst, X, _ = _train_binary(rng)
+    bst.predict(X)
+    cache = bst._gbdt._predictor
+    assert len(cache._entries) == 1
+    bst.update()  # training an iteration must drop device-resident packs
+    assert len(cache._entries) == 0
+    p = bst.predict(X)
+    assert len(cache._entries) == 1
+    # sliced predicts get their own entries, bounded by the LRU capacity
+    bst.predict(X, num_iteration=2)
+    assert len(cache._entries) == 2
+    np.testing.assert_array_equal(p, bst.predict(X))
+
+
+def test_model_load_predict_matches(rng):
+    bst, X, _ = _train_binary(rng)
+    p = bst.predict(X)
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(loaded.predict(X), p, rtol=1e-6, atol=1e-9)
+
+
+def test_streamed_predict_bit_identical(rng):
+    bst, X, _ = _train_binary(rng, n=3000)
+    single = bst.predict(X, raw_score=True)
+    chunked = bst.predict(X, raw_score=True, pred_chunk_rows=512)
+    np.testing.assert_array_equal(single, chunked)
+    # non-power-of-two request rounds up to a bucket; tail chunk included
+    chunked2 = bst.predict(X, raw_score=True, pred_chunk_rows=700)
+    np.testing.assert_array_equal(single, chunked2)
+
+
+def test_streamed_predict_env_var(rng, monkeypatch):
+    from lightgbm_tpu.utils.timer import global_timer
+
+    bst, X, _ = _train_binary(rng, n=2000)
+    single = bst.predict(X, raw_score=True)
+    monkeypatch.setenv("LGBM_TPU_PREDICT_CHUNK", "256")
+    before = global_timer.counters.get("predict_stream_chunks", 0)
+    streamed = bst.predict(X, raw_score=True)
+    assert global_timer.counters.get("predict_stream_chunks", 0) > before
+    np.testing.assert_array_equal(single, streamed)
+
+
+def test_stream_chunk_policy():
+    from lightgbm_tpu.ops.predict import stream_chunk_rows
+
+    assert stream_chunk_rows(1000) == 0          # small batch: single shot
+    assert stream_chunk_rows(1000, 256) == 256   # explicit request wins
+    assert stream_chunk_rows(1000, 0) == 0       # 0 disables
+    assert stream_chunk_rows(1000, 300) == 512   # rounds up to a bucket
+    assert stream_chunk_rows(1 << 20) == 1 << 18  # auto for huge batches
+
+
+def test_sharded_predict_bit_identical(rng, monkeypatch):
+    import jax
+
+    assert jax.device_count() == 8  # conftest forces the fake CPU mesh
+    bst, X, _ = _train_binary(rng, n=1000)
+    single = bst.predict(X, raw_score=True)
+    monkeypatch.setenv("LGBM_TPU_PREDICT_SHARD", "1")
+    sharded = bst.predict(X, raw_score=True)
+    np.testing.assert_array_equal(single, sharded)
+    # transformed output and a row count not divisible by 8 (pads + crops)
+    single_p = bst.predict(X[:997])
+    sharded_p = bst.predict(X[:997])
+    np.testing.assert_array_equal(single_p, sharded_p)
+
+
+def test_sharded_predict_multiclass_ops_level(rng, monkeypatch):
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.predict import predict_raw
+    from lightgbm_tpu.parallel.predict import predict_raw_sharded
+
+    X = rng.randn(400, 4)
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)).astype(float)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    packed = bst._gbdt._packed()
+    X32 = X.astype(np.float32)
+    single = np.asarray(predict_raw(packed, jnp.asarray(X32), 3))
+    sharded = predict_raw_sharded(packed, X32, 3)
+    np.testing.assert_array_equal(single, sharded)
+
+
+def test_sharded_predict_env_off(rng, monkeypatch):
+    from lightgbm_tpu.parallel.predict import sharded_predict_enabled
+
+    monkeypatch.setenv("LGBM_TPU_PREDICT_SHARD", "0")
+    assert not sharded_predict_enabled(1 << 20)
+    monkeypatch.setenv("LGBM_TPU_PREDICT_SHARD", "1")
+    assert sharded_predict_enabled(16)
+    monkeypatch.delenv("LGBM_TPU_PREDICT_SHARD")
+    assert not sharded_predict_enabled(100)      # small: auto stays off
+    assert sharded_predict_enabled(1 << 16)      # auto for big batches
+
+
+def test_pred_chunk_rows_param_accepted(rng):
+    # pred_chunk_rows through params (not kwargs), the CLI-config route
+    X = rng.randn(500, 5)
+    y = (X[:, 0] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "pred_chunk_rows": 128},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    single = bst._gbdt.predict(X.astype(np.float32), raw_score=True)
+    via_params = bst.predict(X, raw_score=True)
+    np.testing.assert_array_equal(np.asarray(single)[:, 0]
+                                  if np.asarray(single).ndim > 1
+                                  else np.asarray(single), via_params)

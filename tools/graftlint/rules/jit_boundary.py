@@ -12,6 +12,15 @@ via `partial(jax.jit, ...)`) seed the set, which closes over same-module
 calls by name (including `self.method` calls) and nested defs. Cross-module
 reachability is intentionally out of scope — each hot module is linted on
 its own jitted surface (docs/LINTING.md#r1 for the escape hatch).
+
+The rule also covers the driver side of the boundary: a host loop that
+pulls each dispatched result straight back (`np.asarray(jitted_fn(x))`
+per iteration — the shape of the pre-rewrite predict_raw_early_stop)
+serializes the dispatch pipeline just as surely. Loop bodies in
+NON-jit-reachable functions are scanned for host-sync calls whose
+argument dispatches a same-module jit-reachable function; pulls of a
+previously-dispatched value (a bare name, e.g. double-buffered
+copy_to_host_async drains) stay clean.
 """
 from __future__ import annotations
 
@@ -109,6 +118,73 @@ class JitBoundaryRule(Rule):
                 frontier = nxt
             for qual in sorted(reachable):
                 out.extend(self._check_function(ctx, qual, funcs[qual]))
+            # driver-side: functions that (transitively) CALL jit-reachable
+            # code are dispatch points; a host sync on a fresh dispatch
+            # inside a loop serializes the pipeline per iteration
+            dispatch = set(reachable)
+            grew = True
+            while grew:
+                grew = False
+                for qual, fn in funcs.items():
+                    if qual in dispatch:
+                        continue
+                    if callees(fn) & dispatch:
+                        dispatch.add(qual)
+                        grew = True
+            dispatch_short = {q.rsplit(".", 1)[-1] for q in dispatch}
+            for qual, fn in funcs.items():
+                if qual in reachable:
+                    continue  # already fully checked above
+                out.extend(self._check_loop_syncs(ctx, qual, fn,
+                                                  dispatch_short))
+        return out
+
+    def _check_loop_syncs(self, ctx, qual: str, fn: ast.AST,
+                          dispatch_short: Set[str]) -> List[Violation]:
+        def dispatches(node: ast.AST) -> bool:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    f = sub.func
+                    if isinstance(f, ast.Name) and f.id in dispatch_short:
+                        return True
+                    if isinstance(f, ast.Attribute) and f.attr in dispatch_short:
+                        return True
+            return False
+
+        seen: Set[tuple] = set()
+        out: List[Violation] = []
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                fname = dotted_name(f)
+                is_sync = (
+                    (isinstance(f, ast.Name) and f.id in _HOST_BUILTINS)
+                    or (isinstance(f, ast.Attribute)
+                        and f.attr in _HOST_METHODS)
+                    or (fname.startswith("np.") and fname[3:] in _NP_CALLS)
+                    or fname in _JAX_HOST)
+                if not is_sync:
+                    continue
+                roots = list(node.args)
+                if isinstance(f, ast.Attribute):
+                    roots.append(f.value)  # jitted_fn(x).item()
+                if not any(dispatches(r) for r in roots):
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(self.violation(
+                    ctx, node,
+                    "per-iteration host sync on a fresh dispatch inside a "
+                    "loop in %r serializes the dispatch pipeline (the old "
+                    "predict_raw_early_stop pattern) — hoist the pull out "
+                    "of the loop or double-buffer with copy_to_host_async"
+                    % qual))
         return out
 
     def _check_function(self, ctx, qual: str, fn: ast.AST) -> List[Violation]:
