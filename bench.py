@@ -186,6 +186,37 @@ def run_bench(n_rows: int) -> dict:
     out["predict_rows_per_sec"] = round(n_rows / pe, 1)
     out["predict_chunk_rows"] = pred_chunk
 
+    # robustness-layer cost: one full-state checkpoint write of the trained
+    # model (model text + sidecar, atomic + fsync) ...
+    import tempfile
+
+    from lightgbm_tpu.checkpoint import save_checkpoint
+
+    with tempfile.TemporaryDirectory() as td:
+        t0 = time.perf_counter()
+        save_checkpoint(bst, os.path.join(td, "bench_model.txt"))
+        out["checkpoint_write_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+
+    # ... and the numerical-health guardrail at its most expensive setting
+    # (policy=warn, sync every iteration) vs the same short train without it
+    g_rows = min(n_rows, 100_000)
+    Xg, yg = X[:g_rows], y[:g_rows]
+
+    def _short_train(extra: dict) -> float:
+        dg = lgb.Dataset(Xg, label=yg)
+        bg = lgb.Booster(params={**params, **extra}, train_set=dg)
+        for _ in range(WARMUP_ITERS):
+            bg.update()
+        t0 = time.perf_counter()
+        for _ in range(N_ITERS):
+            bg.update()
+        return time.perf_counter() - t0
+
+    base_s = _short_train({})
+    guard_s = _short_train({"health_check_policy": "warn",
+                            "health_check_every": 1})
+    out["guardrail_overhead_pct"] = round((guard_s / base_s - 1.0) * 100.0, 2)
+
     # secondary quantized capture defaults ON only at moderate sizes — at
     # full HIGGS scale it would double the remote-compile + train time and
     # risk the round's single capture window
@@ -252,7 +283,8 @@ def main() -> None:
             for k in ("auc", "quantized_row_iters_per_sec", "quantized_auc",
                       "quantized_error", "device_hist_rows",
                       "est_carried_bytes_per_wave", "predict_rows_per_sec",
-                      "predict_chunk_rows"):
+                      "predict_chunk_rows", "checkpoint_write_ms",
+                      "guardrail_overhead_pct"):
                 if k in res:
                     record[k] = res[k]
             emit(record)

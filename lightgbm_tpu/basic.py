@@ -331,8 +331,10 @@ class Dataset:
         self.construct()
         h = self._handle
         md = h.metadata
-        with open(filename, "wb") as fh:  # file object: numpy must not
-            np.savez_compressed(  # append .npz to the requested name
+        from .checkpoint import atomic_open
+
+        with atomic_open(filename, "wb") as fh:  # file object: numpy must
+            np.savez_compressed(  # not append .npz to the requested name
                 fh, bins=h.bins,
                 label=md.label if md.label is not None else [],
                 weight=md.weights if md.weights is not None else [],

@@ -1,0 +1,333 @@
+"""Crash-consistent checkpointing: full trainer state, written atomically.
+
+The reference's ``snapshot_freq`` (gbdt.cpp:258-262) writes the model text
+mid-train; resuming from it with ``init_model`` silently diverges from the
+uninterrupted run because none of the trainer state — bagging/feature RNG
+streams, early-stop bookkeeping, quantized-gradient PRNG, the f32 score
+arrays — survives. A checkpoint here is two artifacts:
+
+* ``<path>``           — the plain reference-format model text (loadable by
+                         any LightGBM, reference included), ALL trees.
+* ``<path>.ckpt``      — a sidecar blob: 8-byte magic ``LGBMCKPT`` +
+                         sha256(payload) + an npz payload holding a JSON
+                         manifest (iteration counter, early-stop state,
+                         learner scalars, sha256 of the model text) and the
+                         state arrays (train/valid scores, bag indices,
+                         column-sampler MT19937 keys, quantized PRNG key).
+
+Both are written atomically — temp file in the target directory, flush +
+fsync, ``os.replace``, directory fsync — inside a bounded
+retry-with-backoff loop, so a crash at ANY instant leaves either the old
+checkpoint or the new one, never a torn file. The sidecar references the
+model text by content hash: if either half is missing, damaged, or from a
+different write, ``load_checkpoint`` invalidates the pair with a warning
+and training falls back to plain continued training from the model text
+alone. ``engine.train(init_model=<path>)`` with a valid sidecar resumes
+BIT-IDENTICALLY to the uninterrupted run (docs/ROBUSTNESS.md).
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import tempfile
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .utils import faults
+from .utils.log import Log
+from .utils.timer import global_timer
+
+CKPT_MAGIC = b"LGBMCKPT"
+CKPT_VERSION = 1
+SIDECAR_SUFFIX = ".ckpt"
+_BACKOFF_S = 0.05  # doubled per retry attempt
+
+
+class CheckpointError(Exception):
+    """Sidecar validation failure; callers treat it as 'no sidecar'."""
+
+
+# ------------------------------------------------------------ atomic writes
+
+def _fsync_dir(dirname: str) -> None:
+    """Durability of the os.replace itself: fsync the directory entry
+    (best effort — some filesystems refuse O_RDONLY dir fsync)."""
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+@contextmanager
+def atomic_open(path: str, mode: str = "w"):
+    """Yield a temp-file handle in `path`'s directory; on clean exit flush +
+    fsync + os.replace onto `path`, on failure unlink the temp file. The
+    single-shot primitive for streaming writers (Dataset.save_binary);
+    whole-content writes go through atomic_write_text/bytes, which add the
+    bounded retry-with-backoff loop."""
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".tmp.")
+    try:
+        with os.fdopen(fd, mode) as fh:
+            yield fh
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(d)
+
+
+def _atomic_write(path: str, data, mode: str, retries: int) -> None:
+    last: Optional[OSError] = None
+    for attempt in range(max(1, retries)):
+        if attempt:
+            time.sleep(_BACKOFF_S * (2 ** (attempt - 1)))
+        try:
+            faults.maybe_fail_write(path)
+            with atomic_open(path, mode) as fh:
+                fh.write(data)
+            faults.maybe_corrupt_artifact(path)
+            return
+        except OSError as exc:
+            last = exc
+            Log.warning("Atomic write of %s failed (attempt %d/%d): %s",
+                        path, attempt + 1, max(1, retries), exc)
+    raise last
+
+
+def atomic_write_text(path: str, text: str, retries: int = 3) -> None:
+    _atomic_write(path, text, "w", retries)
+
+
+def atomic_write_bytes(path: str, data: bytes, retries: int = 3) -> None:
+    _atomic_write(path, data, "wb", retries)
+
+
+# ------------------------------------------------------------- state model
+
+@dataclass
+class TrainerState:
+    """Everything load_checkpoint recovered from a valid snapshot pair."""
+
+    iteration: int
+    model_text: str
+    score: np.ndarray
+    valid_scores: List[np.ndarray]
+    bag: Optional[np.ndarray]
+    learner: Dict[str, Any]
+    es: Optional[Dict[str, Any]]
+    health: Optional[Dict[str, Any]]
+    manifest: Dict[str, Any]
+
+
+_model_only_warned = False
+
+
+def save_checkpoint(booster, path: str, retries: int = 3) -> None:
+    """Write a crash-consistent snapshot of `booster` (a Booster, or a raw
+    GBDT driver in learner-level tests) to `path` + `path`.ckpt."""
+    global _model_only_warned
+    gbdt = getattr(booster, "_gbdt", booster)
+    gbdt._flush_pending()  # a half-grown async tree is not checkpointable
+    model_text = gbdt.to_model().to_string(num_iteration=-1)
+    with global_timer.scope("checkpoint_write"):
+        atomic_write_text(path, model_text, retries=retries)
+        if type(gbdt).__name__ != "GBDT":
+            # DART/RF carry per-iteration state (drop sets, averaging) that
+            # has no resume contract yet: their snapshot is model-only and
+            # resume falls back to plain continued training.
+            if not _model_only_warned:
+                _model_only_warned = True
+                Log.warning("Checkpoint for boosting type %s saves model "
+                            "text only; resume will not be bit-identical",
+                            type(gbdt).__name__)
+            return
+        arrays: Dict[str, np.ndarray] = {"score": np.asarray(gbdt.score)}
+        for i, vd in enumerate(gbdt.valid_sets):
+            arrays[f"valid_score_{i}"] = np.asarray(vd.score)
+        bag = getattr(gbdt.sample_strategy, "_bag", None)
+        if bag is not None:
+            arrays["bag"] = np.asarray(bag, dtype=np.int32)
+        learner_scalars: Dict[str, Any] = {}
+        learner = getattr(gbdt, "tree_learner", None)
+        if learner is not None and hasattr(learner, "snapshot_state"):
+            for k, v in learner.snapshot_state().items():
+                if isinstance(v, np.ndarray):
+                    arrays[f"learner_{k}"] = v
+                else:
+                    learner_scalars[k] = v
+        health = getattr(gbdt, "_health", None)
+        manifest = {
+            "version": CKPT_VERSION,
+            "iteration": int(gbdt.iter_),
+            "num_class": int(gbdt.num_class),
+            "num_tree_per_iteration": int(gbdt.num_tree_per_iteration),
+            "num_data": int(getattr(gbdt, "num_data", -1)),
+            "boosting": type(gbdt).__name__,
+            "model_sha256": hashlib.sha256(model_text.encode()).hexdigest(),
+            "valid_names": list(gbdt.valid_names),
+            "async_stub_stop": bool(gbdt._async_stub_stop),
+            "learner": learner_scalars,
+            "es": getattr(booster, "_early_stop_state", None),
+            "health": health.snapshot() if health is not None else None,
+        }
+        buf = io.BytesIO()
+        np.savez_compressed(
+            buf,
+            manifest=np.frombuffer(json.dumps(manifest).encode("utf-8"),
+                                   dtype=np.uint8),
+            **arrays)
+        payload = buf.getvalue()
+        blob = CKPT_MAGIC + hashlib.sha256(payload).digest() + payload
+        atomic_write_bytes(path + SIDECAR_SUFFIX, blob, retries=retries)
+
+
+def load_checkpoint(path: str) -> Optional[TrainerState]:
+    """Validate and load the snapshot pair at `path`. Returns None — with a
+    warning naming the failed invariant — whenever the sidecar is absent or
+    unusable, so callers degrade to plain continued training instead of
+    crashing on damaged state."""
+    sidecar = path + SIDECAR_SUFFIX
+    if not os.path.exists(sidecar):
+        return None
+    try:
+        with open(sidecar, "rb") as fh:
+            blob = fh.read()
+        if blob[:len(CKPT_MAGIC)] != CKPT_MAGIC:
+            raise CheckpointError("bad magic")
+        digest = blob[len(CKPT_MAGIC):len(CKPT_MAGIC) + 32]
+        payload = blob[len(CKPT_MAGIC) + 32:]
+        if hashlib.sha256(payload).digest() != digest:
+            raise CheckpointError("payload checksum mismatch")
+        z = np.load(io.BytesIO(payload), allow_pickle=False)
+        manifest = json.loads(bytes(z["manifest"].tobytes()).decode("utf-8"))
+        if int(manifest.get("version", -1)) != CKPT_VERSION:
+            raise CheckpointError(
+                "unsupported checkpoint version %r" % manifest.get("version"))
+        with open(path) as fh:
+            model_text = fh.read()
+        if (hashlib.sha256(model_text.encode()).hexdigest()
+                != manifest["model_sha256"]):
+            raise CheckpointError(
+                "model text does not match the sidecar's content hash "
+                "(the two files are from different writes)")
+        valid_scores = []
+        for i in range(len(manifest.get("valid_names", []))):
+            valid_scores.append(np.asarray(z[f"valid_score_{i}"]))
+        learner: Dict[str, Any] = dict(manifest.get("learner", {}))
+        for k in z.files:
+            if k.startswith("learner_"):
+                learner[k[len("learner_"):]] = np.asarray(z[k])
+        return TrainerState(
+            iteration=int(manifest["iteration"]),
+            model_text=model_text,
+            score=np.asarray(z["score"]),
+            valid_scores=valid_scores,
+            bag=np.asarray(z["bag"]) if "bag" in z.files else None,
+            learner=learner,
+            es=manifest.get("es"),
+            health=manifest.get("health"),
+            manifest=manifest)
+    except Exception as exc:  # noqa: BLE001 - ANY damage means "no sidecar"
+        Log.warning("Checkpoint sidecar %s is unusable (%s); falling back "
+                    "to plain continued training from the model file",
+                    sidecar, exc)
+        return None
+
+
+def restore_trainer_state(booster, state: TrainerState,
+                          callbacks=()) -> int:
+    """Reinstate `state` onto a freshly constructed booster: trees +
+    iteration counter, f32 score arrays (train + valids), bagging cache,
+    learner RNG/scan state, async-pipeline carry, early-stop bookkeeping.
+    Returns the iteration to resume from. Structural mismatches between the
+    checkpoint and the resume call are fatal with a named invariant — a
+    silently divergent resume is worse than no resume."""
+    import jax.numpy as jnp
+
+    from .models.serialize import GBDTModel
+
+    gbdt = getattr(booster, "_gbdt", booster)
+    man = state.manifest
+    if man.get("boosting") != type(gbdt).__name__:
+        Log.fatal("Checkpoint was written by boosting type %s but the "
+                  "resume run built %s — refusing to resume",
+                  man.get("boosting"), type(gbdt).__name__)
+    if int(man["num_data"]) != int(gbdt.num_data):
+        Log.fatal("Checkpoint was written for %d training rows but the "
+                  "resume dataset has %d — refusing to resume",
+                  int(man["num_data"]), int(gbdt.num_data))
+    if int(man["num_tree_per_iteration"]) != int(gbdt.num_tree_per_iteration):
+        Log.fatal("Checkpoint has %d trees/iteration but the resume run "
+                  "has %d — refusing to resume",
+                  int(man["num_tree_per_iteration"]),
+                  int(gbdt.num_tree_per_iteration))
+    if list(man.get("valid_names", [])) != list(gbdt.valid_names):
+        Log.fatal("Checkpoint valid sets %s do not match the resume call's "
+                  "%s (same valid_sets, same order, same names required)",
+                  man.get("valid_names"), gbdt.valid_names)
+    gbdt.models = GBDTModel.from_string(state.model_text).trees
+    gbdt.iter_ = int(state.iteration)
+    gbdt._async_stub_stop = bool(man.get("async_stub_stop", False))
+    gbdt.score = jnp.asarray(state.score, dtype=jnp.float32)
+    for vd, s in zip(gbdt.valid_sets, state.valid_scores):
+        vd.score = jnp.asarray(s, dtype=jnp.float32)
+    if state.bag is not None and hasattr(gbdt.sample_strategy, "_bag"):
+        gbdt.sample_strategy._bag = np.asarray(state.bag, dtype=np.int32)
+    learner = getattr(gbdt, "tree_learner", None)
+    if learner is not None and hasattr(learner, "restore_snapshot_state"):
+        learner.restore_snapshot_state(state.learner)
+    health = getattr(gbdt, "_health", None)
+    if health is not None and state.health is not None:
+        health.restore(state.health)
+    for cb in callbacks or ():
+        if getattr(cb, "_accepts_state_restore", False):
+            cb._pending_restore = state.es
+    gbdt._predictor.invalidate()
+    Log.info("Resumed trainer state from checkpoint: iteration %d, %d trees",
+             gbdt.iter_, len(gbdt.models))
+    return int(state.iteration)
+
+
+# ---------------------------------------------------------------- callback
+
+def checkpoint_callback(path: Union[str, Callable[[int], str]],
+                        period: int = 1, retries: int = 3) -> Callable:
+    """After-iteration callback writing a full crash-consistent snapshot
+    every `period` iterations. `path` is a fixed file name or a callable
+    mapping the 1-based finished-iteration count to one (the CLI names
+    snapshots ``<output_model>.snapshot_iter_<k>``). Runs at order 40 —
+    after early stopping (order 30), so the snapshot carries the freshest
+    early-stop state and a stop iteration is never snapshotted."""
+    if period <= 0:
+        raise ValueError("checkpoint period must be positive")
+
+    def _callback(env) -> None:
+        it = env.iteration + 1
+        if it % period != 0:
+            return
+        if not hasattr(env.model, "_gbdt"):
+            return  # CVBooster: per-fold checkpointing has no single state
+        target = path(it) if callable(path) else path
+        save_checkpoint(env.model, target, retries=retries)
+
+    _callback.order = 40
+    _callback.before_iteration = False
+    return _callback

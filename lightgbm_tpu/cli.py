@@ -15,6 +15,7 @@ from typing import Dict, List
 import numpy as np
 
 from . import callback as callback_mod
+from . import checkpoint as checkpoint_mod
 from .basic import Booster, Dataset
 from .config import Config, key_alias_transform, kv2map, load_config_file
 from .engine import train as train_fn
@@ -90,18 +91,17 @@ def _task_train(config: Config, params: Dict[str, str]) -> int:
     callbacks = [callback_mod.log_evaluation(period=max(config.metric_freq, 1))]
     out = config.output_model or "LightGBM_model.txt"
     if config.snapshot_freq > 0:
-        freq = config.snapshot_freq
-
-        def _snapshot(env) -> None:
-            # gbdt.cpp:258-262: periodic model checkpoints during training
-            if (env.iteration + 1) % freq == 0:
-                env.model.save_model(f"{out}.snapshot_iter_{env.iteration + 1}")
-
-        _snapshot.order = 30
-        callbacks.append(_snapshot)
+        # gbdt.cpp:258-262 periodic checkpoints, upgraded from bare model
+        # text to crash-consistent full-state snapshots: each
+        # <out>.snapshot_iter_<k> model file gains a .ckpt sidecar, and
+        # input_model=<snapshot> resumes bit-identically
+        callbacks.append(checkpoint_mod.checkpoint_callback(
+            lambda it: f"{out}.snapshot_iter_{it}",
+            period=config.snapshot_freq))
     booster = train_fn(params, train_ds, num_boost_round=config.num_iterations,
                        valid_sets=valid_sets or None,
                        valid_names=valid_names or None,
+                       init_model=config.input_model or None,
                        callbacks=callbacks)
     booster.save_model(out)
     Log.info("Finished training, model saved to %s", out)
@@ -161,12 +161,10 @@ def _task_convert(config: Config, params: Dict[str, str]) -> int:
     model = GBDTModel.from_file(config.input_model)
     out = config.convert_model or "gbdt_prediction.cpp"
     if config.convert_model_language in ("", "cpp"):
-        with open(out, "w") as fh:
-            fh.write(model_to_cpp(model))
+        checkpoint_mod.atomic_write_text(out, model_to_cpp(model))
         Log.info("Model converted to if-else C++ at %s", out)
     elif config.convert_model_language == "json":
-        with open(out, "w") as fh:
-            fh.write(model.dump_json())
+        checkpoint_mod.atomic_write_text(out, model.dump_json())
         Log.info("Model converted (JSON form) to %s", out)
     else:
         Log.fatal("Unknown convert_model_language %s",

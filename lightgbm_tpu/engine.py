@@ -13,6 +13,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Union
 import numpy as np
 
 from . import callback as callback_mod
+from . import checkpoint as checkpoint_mod
 from .basic import Booster, Dataset
 from .callback import CallbackEnv, EarlyStopException
 from .config import key_alias_transform
@@ -60,11 +61,26 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if num_boost_round <= 0:
         raise ValueError("num_boost_round should be greater than zero.")
     predictor_model = None
+    ckpt_state = None
     if isinstance(init_model, (str,)):
-        predictor_model = Booster(model_file=init_model)
+        # a full-state checkpoint sidecar next to the model file means
+        # bit-identical resume: trainer state is reinstated onto the fresh
+        # booster below and the predict-seeded init_score path is skipped
+        ckpt_state = checkpoint_mod.load_checkpoint(init_model)
+        if ckpt_state is None:
+            predictor_model = Booster(model_file=init_model)
     elif isinstance(init_model, Booster):
         predictor_model = init_model
-    init_iteration = predictor_model.current_iteration() if predictor_model else 0
+    if ckpt_state is not None:
+        # checkpoint resume finishes the ORIGINAL run: re-running the same
+        # command (same num_boost_round) after a crash reproduces the
+        # uninterrupted run bit for bit, parameters echo included. Plain
+        # init_model (no sidecar) keeps continued-training semantics below:
+        # num_boost_round MORE iterations on top of the loaded model.
+        init_iteration = ckpt_state.iteration
+        num_boost_round = max(num_boost_round - init_iteration, 0)
+    else:
+        init_iteration = predictor_model.current_iteration() if predictor_model else 0
 
     train_set.params = {**train_set.params, **params}
     if predictor_model is not None:
@@ -109,6 +125,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
                               key=lambda cb: getattr(cb, "order", 0))
     callbacks_after = sorted((cb for cb in cbs if not getattr(cb, "before_iteration", False)),
                              key=lambda cb: getattr(cb, "order", 0))
+
+    if ckpt_state is not None:
+        checkpoint_mod.restore_trainer_state(booster, ckpt_state,
+                                             callbacks_after)
 
     booster.best_iteration = -1
     is_finished = False

@@ -1,0 +1,184 @@
+"""Numerical-health guardrails for the boosting loop.
+
+A diverging run on TPU looks like this: one bad gradient wave (overflow in
+a custom objective, a NaN feature snuck past ingest, an XLA bug) silently
+turns the root histogram totals NaN, every split gain goes NaN, the learner
+emits stub trees, and a multi-hour job "converges" to garbage — or worse,
+early-stops gracefully and reports success. The monitor makes that loud:
+
+* **observe** — per-iteration on-device finiteness reductions over the gh
+  wave and the score matrix, AND-accumulated into one boolean scalar.
+  No host sync: the accumulator stays on device.
+* **admit** — every ``check_every`` iterations the accumulated boolean is
+  synced once (ONE scalar D2H per window — the async pipeline stays hot)
+  together with a host-side check of the freshest committed tree's leaf
+  values / split gains. On failure the configured policy runs:
+
+  - ``fatal``    — Log.fatal with the iteration number (default loud stop)
+  - ``warn``     — log and keep going (observability only)
+  - ``rollback`` — restore the last healthy backup (device copies of the
+                   score arrays + model-list length taken at each healthy
+                   sync), recompute gradients from the restored scores, and
+                   continue with NaN-sanitized + clipped gh from then on.
+
+Cost model in docs/ROBUSTNESS.md: the reductions fuse into the gradient
+pass; the only serialization point is the one bool() sync per window.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .utils.log import Log
+
+_POLICIES = ("fatal", "warn", "rollback")
+GRAD_CLIP = 1e6  # post-rollback clip bound for gradients/hessians
+
+
+def create_monitor(config) -> Optional["HealthMonitor"]:
+    policy = str(getattr(config, "health_check_policy", "") or "").strip()
+    if not policy:
+        return None
+    if policy not in _POLICIES:
+        Log.fatal("Unknown health_check_policy %r (choose one of %s)",
+                  policy, "/".join(_POLICIES))
+    return HealthMonitor(policy, int(getattr(config, "health_check_every", 10)))
+
+
+class HealthMonitor:
+    def __init__(self, policy: str, check_every: int = 10) -> None:
+        self.policy = policy
+        self.check_every = max(1, int(check_every))
+        self.clip_on = False  # armed permanently after a rollback recovery
+        self._acc = None      # device bool: AND of all observations so far
+        self._host_ok = True  # host-side tree-structure observations
+        self._since_sync = 0
+        self._backup = None   # (iter_, n_models, score, [valid scores])
+
+    # ------------------------------------------------------------ observers
+
+    def observe(self, *arrays) -> None:
+        """Fold finiteness of device arrays into the accumulator (no sync)."""
+        import jax.numpy as jnp
+
+        for a in arrays:
+            if a is None:
+                continue
+            ok = jnp.isfinite(a).all()
+            self._acc = ok if self._acc is None else jnp.logical_and(
+                self._acc, ok)
+
+    def observe_tree(self, tree) -> None:
+        """Host-side finiteness of a committed tree's outputs (leaf values,
+        split gains) — trees are already host-resident after replay, so this
+        costs no device sync."""
+        import numpy as np
+
+        n = int(tree.num_leaves)
+        if n <= 0:
+            return
+        ok = bool(np.isfinite(np.asarray(tree.leaf_value[:n])).all())
+        if ok and n > 1:
+            ok = bool(np.isfinite(np.asarray(tree.split_gain[:n - 1])).all())
+        if not ok:
+            self._host_ok = False
+
+    # -------------------------------------------------------------- admit
+
+    def admit(self, gbdt, grads, hesses):
+        """Gate iteration `gbdt.iter_`'s gh wave. Called after the gradient
+        pass, BEFORE bagging/tree growth, so an unhealthy wave is caught in
+        the same iteration and never grows a tree."""
+        self.observe(grads, hesses, gbdt.score)
+        self._since_sync += 1
+        if self._since_sync >= self.check_every:
+            healthy = ((self._acc is None or bool(self._acc))
+                       and self._host_ok)
+            self._acc = None
+            self._host_ok = True
+            self._since_sync = 0
+            if not healthy:
+                grads, hesses = self._handle(gbdt, grads, hesses)
+            elif self.policy == "rollback":
+                self._take_backup(gbdt)
+        if self.clip_on:
+            grads, hesses = self._sanitize(grads, hesses)
+        return grads, hesses
+
+    # ------------------------------------------------------------ handlers
+
+    def _handle(self, gbdt, grads, hesses):
+        it = int(gbdt.iter_)
+        if self.policy == "fatal":
+            Log.fatal("Numerical health check failed at iteration %d: "
+                      "non-finite values in gradients/hessians/scores or "
+                      "committed tree outputs", it)
+        if self.policy == "warn":
+            Log.warning("Numerical health check failed at iteration %d "
+                        "(policy=warn: continuing)", it)
+            return grads, hesses
+        # rollback: restore the last healthy snapshot and re-boost with
+        # sanitized, clipped gradients from the restored scores
+        gbdt._flush_pending()
+        rolled = self._restore_backup(gbdt)
+        Log.warning("Numerical health check failed at iteration %d; rolled "
+                    "back %d iteration(s) to %d and re-boosting with "
+                    "clipped gradients", it, rolled, int(gbdt.iter_))
+        self.clip_on = True
+        if gbdt._grad_fn is not None:
+            score = gbdt.score if gbdt.num_tree_per_iteration > 1 \
+                else gbdt.score[0]
+            grads, hesses = gbdt._grad_fn(score)
+        return self._sanitize(grads, hesses)
+
+    @staticmethod
+    def _sanitize(grads, hesses):
+        import jax.numpy as jnp
+
+        g = jnp.clip(jnp.nan_to_num(grads, nan=0.0, posinf=GRAD_CLIP,
+                                    neginf=-GRAD_CLIP), -GRAD_CLIP, GRAD_CLIP)
+        h = jnp.clip(jnp.nan_to_num(hesses, nan=0.0, posinf=GRAD_CLIP,
+                                    neginf=0.0), 0.0, GRAD_CLIP)
+        return g, h
+
+    # ------------------------------------------------------------- backups
+
+    def _take_backup(self, gbdt) -> None:
+        import jax.numpy as jnp
+
+        self._backup = (
+            int(gbdt.iter_),
+            len(gbdt.models),
+            jnp.array(gbdt.score, copy=True),
+            [jnp.array(vd.score, copy=True) for vd in gbdt.valid_sets],
+        )
+
+    def _restore_backup(self, gbdt) -> int:
+        import jax.numpy as jnp
+
+        if self._backup is None:
+            # no healthy sync happened yet: nothing to roll back to — scrub
+            # the live scores in place so re-boosting can proceed
+            gbdt.score = jnp.nan_to_num(gbdt.score, nan=0.0,
+                                        posinf=GRAD_CLIP, neginf=-GRAD_CLIP)
+            for vd in gbdt.valid_sets:
+                vd.score = jnp.nan_to_num(vd.score, nan=0.0,
+                                          posinf=GRAD_CLIP, neginf=-GRAD_CLIP)
+            return 0
+        it, n_models, score, valid_scores = self._backup
+        rolled = int(gbdt.iter_) - it
+        del gbdt.models[n_models:]
+        gbdt.iter_ = it
+        gbdt.score = score
+        for vd, s in zip(gbdt.valid_sets, valid_scores):
+            vd.score = s
+        gbdt._predictor.invalidate()
+        self._backup = None  # consumed; next healthy sync takes a fresh one
+        return rolled
+
+    # ------------------------------------------------------ checkpointing
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"clip_on": bool(self.clip_on)}
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        self.clip_on = bool(state.get("clip_on", False))

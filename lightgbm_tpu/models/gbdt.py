@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config
+from ..health import create_monitor
 from ..io.dataset import Dataset
 from ..metrics import create_metric
 from ..objectives import ObjectiveFunction
@@ -27,6 +28,7 @@ from ..ops.predict import (PredictorCache, pack_ensemble, predict_dtype,
                            stream_chunk_rows)
 from ..ops.score import add_tree_to_score
 from ..treelearner import create_tree_learner
+from ..utils import faults
 from ..utils.log import Log
 from ..utils.timer import global_timer
 from .sample_strategy import create_sample_strategy
@@ -128,6 +130,8 @@ class GBDT:
         # handle of the last dispatched tree, finalized one iteration later
         self._pending = None
         self._async_stub_stop = False
+        # numerical-health guardrails (None unless health_check_policy set)
+        self._health = create_monitor(config)
 
         if train_set is not None:
             n = train_set.num_data
@@ -278,6 +282,7 @@ class GBDT:
                        hessians: Optional[np.ndarray] = None) -> bool:
         """Returns True when training should STOP (no more valid splits) —
         matching LGBM_BoosterUpdateOneIter's is_finished flag."""
+        faults.check_kill(self.iter_)
         if self._async_stub_stop:
             self._async_stub_stop = False
             Log.warning("Stopped training because there are no more leaves "
@@ -303,6 +308,9 @@ class GBDT:
             else:
                 grads, hesses = self._grad_fn(
                     self.score if C > 1 else self.score[0])
+        grads, hesses = faults.maybe_poison_gh(grads, hesses, self.iter_)
+        if self._health is not None:
+            grads, hesses = self._health.admit(self, grads, hesses)
         with global_timer.scope("bagging"):
             bag, grads, hesses = self.sample_strategy.bagging(
                 self.iter_, grads, hesses)
@@ -331,6 +339,8 @@ class GBDT:
                     new_tree = self.tree_learner.train(gh_ext, bag)
             if new_tree.num_leaves > 1:
                 should_continue = True
+                if self._health is not None:
+                    self._health.observe_tree(new_tree)
                 if self.config.linear_tree:
                     from ..treelearner.linear import fit_leaf_linear_models
 

@@ -13,6 +13,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .tree import Tree
+from ..checkpoint import atomic_write_text
 from ..utils.log import Log
 
 MODEL_VERSION = "v4"
@@ -118,13 +119,16 @@ class GBDTModel:
 
     def save_to_file(self, filename: str, start_iteration: int = 0,
                      num_iteration: int = -1, importance_type: str = "split") -> None:
-        with open(filename, "w") as fh:
-            fh.write(self.to_string(start_iteration, num_iteration, importance_type))
+        # atomic (temp + fsync + os.replace): a crash mid-save can never
+        # leave a truncated model file behind
+        atomic_write_text(filename,
+                          self.to_string(start_iteration, num_iteration,
+                                         importance_type))
 
     # ------------------------------------------------------------------- load
 
     @classmethod
-    def from_string(cls, text: str) -> "GBDTModel":
+    def from_string(cls, text: str, source: str = "<string>") -> "GBDTModel":
         model = cls()
         lines = text.split("\n")
         i = 0
@@ -141,27 +145,37 @@ class GBDTModel:
                     key_vals[line] = ""
             i += 1
         if "num_class" not in key_vals:
-            Log.fatal("Model file doesn't specify the number of classes")
-        model.name = lines[0].strip() or "tree"
-        model.num_class = int(key_vals["num_class"])
-        model.num_tree_per_iteration = int(key_vals.get("num_tree_per_iteration", model.num_class))
-        model.label_index = int(key_vals.get("label_index", 0))
+            Log.fatal("Model file %s is truncated or corrupt: missing "
+                      "header key num_class", source)
         if "max_feature_idx" not in key_vals:
-            Log.fatal("Model file doesn't specify max_feature_idx")
-        model.max_feature_idx = int(key_vals["max_feature_idx"])
+            Log.fatal("Model file %s is truncated or corrupt: missing "
+                      "header key max_feature_idx", source)
+        model.name = lines[0].strip() or "tree"
+        try:
+            model.num_class = int(key_vals["num_class"])
+            model.num_tree_per_iteration = int(key_vals.get("num_tree_per_iteration", model.num_class))
+            model.label_index = int(key_vals.get("label_index", 0))
+            model.max_feature_idx = int(key_vals["max_feature_idx"])
+        except ValueError as exc:
+            Log.fatal("Model file %s is truncated or corrupt: garbled "
+                      "header value (%s)", source, exc)
         model.average_output = "average_output" in key_vals
         model.objective_str = key_vals.get("objective") or None
         model.feature_names = key_vals.get("feature_names", "").split()
         if len(model.feature_names) != model.max_feature_idx + 1:
-            Log.fatal("Wrong size of feature_names")
+            Log.fatal("Model file %s: wrong size of feature_names (%d names "
+                      "for max_feature_idx=%d)", source,
+                      len(model.feature_names), model.max_feature_idx)
         model.feature_infos = key_vals.get("feature_infos", "").split()
         if "monotone_constraints" in key_vals and key_vals["monotone_constraints"]:
             model.monotone_constraints = [int(x) for x in key_vals["monotone_constraints"].split()]
 
         # tree sections
+        saw_end = False
         while i < len(lines):
             line = lines[i].rstrip("\r")
             if line.startswith("end of trees"):
+                saw_end = True
                 i += 1
                 break
             if line.startswith("Tree="):
@@ -175,9 +189,21 @@ class GBDTModel:
                         k, v = tline.split("=", 1)
                         tree_kv[k] = v
                     i += 1
-                model.trees.append(Tree.from_key_values(tree_kv))
+                try:
+                    model.trees.append(Tree.from_key_values(tree_kv))
+                except (KeyError, ValueError, IndexError) as exc:
+                    Log.fatal("Model file %s is truncated or corrupt: tree "
+                              "%d has a missing or garbled key (%s)",
+                              source, len(model.trees), exc)
             else:
                 i += 1
+        expected_trees = len(key_vals.get("tree_sizes", "").split())
+        if not saw_end or len(model.trees) != expected_trees:
+            Log.fatal("Model file %s is truncated or corrupt: header "
+                      "declares %d trees but %d parsed%s", source,
+                      expected_trees, len(model.trees),
+                      "" if saw_end else " and the 'end of trees' marker "
+                      "is missing")
         # parameters section
         if "parameters:" in text:
             start = text.index("parameters:") + len("parameters:")
@@ -198,8 +224,12 @@ class GBDTModel:
 
     @classmethod
     def from_file(cls, filename: str) -> "GBDTModel":
-        with open(filename) as fh:
-            return cls.from_string(fh.read())
+        try:
+            with open(filename) as fh:
+                text = fh.read()
+        except OSError as exc:
+            Log.fatal("Cannot read model file %s: %s", filename, exc)
+        return cls.from_string(text, source=filename)
 
     # ------------------------------------------------------------------- JSON
 

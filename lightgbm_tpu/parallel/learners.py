@@ -483,6 +483,20 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         self._params_rep = put_replicated(self.params_dev, self.mesh)
         self._grow_fns = {}
 
+    def snapshot_state(self) -> dict:
+        st = super().snapshot_state()
+        st["n_devices"] = int(self.D)
+        return st
+
+    def restore_snapshot_state(self, st: dict) -> None:
+        n = int(st.get("n_devices", self.D))
+        if n != self.D:
+            Log.warning("Checkpoint was captured on a %d-device mesh; "
+                        "resuming on %d devices. Committed trees are "
+                        "replicated so training stays bit-identical, but "
+                        "per-wave comm volume will differ", n, self.D)
+        super().restore_snapshot_state(st)
+
     def _device_bins(self, dataset: Dataset) -> jax.Array:
         """Rows padded to the sharded tile unit and split on `data` (each
         device holds its contiguous row block); same native-width rules as

@@ -804,6 +804,20 @@ class DeviceTreeLearner(SerialTreeLearner):
                     "bf16 — bit-identity with the f32 path is NOT "
                     "guaranteed (bf16 keeps 8 mantissa bits)")
 
+    def snapshot_state(self) -> dict:
+        st = super().snapshot_state()
+        st["bins_dtype"] = str(self.bins_dev.dtype)
+        return st
+
+    def restore_snapshot_state(self, st: dict) -> None:
+        want = st.get("bins_dtype")
+        if want is not None and want != str(self.bins_dev.dtype):
+            Log.fatal("Checkpoint was captured with a %s bin plane but the "
+                      "resume run built %s (LGBM_TPU_BINS_I32 mismatch?) — "
+                      "histogram accumulation order would differ, breaking "
+                      "bit-identical resume", want, self.bins_dev.dtype)
+        super().restore_snapshot_state(st)
+
     def _payload_cols(self) -> int:
         """Payload columns of the wave carry: gh channels (bf16-packed in
         pairs when opted in) + position + leaf id."""

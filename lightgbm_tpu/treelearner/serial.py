@@ -112,6 +112,50 @@ class SerialTreeLearner:
                 Log.warning("Could not open forced splits file %s",
                             config.forcedsplits_filename)
 
+    # ------------------------------------------------------------- checkpoint
+
+    def snapshot_state(self) -> dict:
+        """Learner state a bit-identical resume needs, split into ndarrays
+        (stored raw in the checkpoint sidecar's npz) and scalars (stored in
+        the JSON manifest): the column-sampler's MT19937 stream, the
+        per-tree quantized-gradient PRNG key, and a structural fingerprint
+        (num_data / padded bin count) that restore refuses to cross."""
+        kind, keys, pos, has_gauss, cached = self.col_sampler.rng.get_state()
+        st = {
+            "rng_kind": kind,
+            "colsampler_keys": np.asarray(keys, dtype=np.uint32),
+            "colsampler_pos": int(pos),
+            "colsampler_has_gauss": int(has_gauss),
+            "colsampler_cached_gaussian": float(cached),
+            "num_data": int(self.num_data),
+            "group_bin_padded": int(self.group_bin_padded),
+        }
+        if self.quantized:
+            st["quant_key"] = np.asarray(self._quant_key, dtype=np.uint32)
+        return st
+
+    def restore_snapshot_state(self, st: dict) -> None:
+        if int(st.get("num_data", self.num_data)) != int(self.num_data) \
+                or int(st.get("group_bin_padded", self.group_bin_padded)) \
+                != int(self.group_bin_padded):
+            Log.fatal("Checkpoint learner state was captured on a different "
+                      "dataset shape (num_data=%s, group_bin_padded=%s vs "
+                      "%d, %d) — refusing to resume",
+                      st.get("num_data"), st.get("group_bin_padded"),
+                      self.num_data, self.group_bin_padded)
+        self.col_sampler.rng.set_state((
+            str(st["rng_kind"]),
+            np.asarray(st["colsampler_keys"], dtype=np.uint32),
+            int(st["colsampler_pos"]),
+            int(st["colsampler_has_gauss"]),
+            float(st["colsampler_cached_gaussian"])))
+        if self.quantized and "quant_key" in st:
+            # plain asarray, NOT device_put: a fresh PRNGKey lives on the
+            # default device, and bit-identity requires matching placement
+            self._quant_key = jnp.asarray(
+                np.asarray(st["quant_key"], dtype=np.uint32),
+                dtype=jnp.uint32)
+
     # ------------------------------------------------------------------ train
 
     def train(self, gh_ext: jax.Array,

@@ -1,0 +1,160 @@
+"""Deterministic fault injection for the fault-tolerance test suite.
+
+A FaultPlan is parsed from a comma-separated spec — either installed
+programmatically (tests call ``install``/``clear``) or read once from the
+``LGBM_TPU_FAULT`` environment variable (CLI runs) with the companion
+``LGBM_TPU_FAULT_SEED`` controlling the poisoning RNG. Supported tokens:
+
+    kill@K              raise InjectedFault at the START of iteration K
+                        (the mid-train process-kill stand-in)
+    nan_gh@K[:frac]     poison `frac` of the gradient/hessian rows with NaN
+                        after iteration K's gradient pass (default 1%)
+    ckpt_write_fail:N   the next N atomic writes raise OSError before the
+                        temp file is created (transient disk failure — the
+                        retry-with-backoff wrapper must absorb them)
+    ckpt_corrupt        flip bytes in the middle of the next checkpoint
+                        sidecar AFTER it is durably written
+    ckpt_truncate       truncate the next model-text artifact to half its
+                        size AFTER it is durably written
+
+Every injection is one-shot (``kill@K`` fires once even if iteration K is
+re-entered after a rollback) and seeded, so a failing fault test replays
+exactly. All hooks are cheap no-ops when no plan is armed — the boosting
+hot loop pays two dict lookups per iteration.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .log import Log
+
+
+class InjectedFault(RuntimeError):
+    """Raised by the kill injection point; stands in for SIGKILL in tests
+    (the checkpoint files on disk are all a real kill would leave)."""
+
+
+class FaultPlan:
+    def __init__(self, spec: str = "", seed: int = 0) -> None:
+        self.spec = spec or ""
+        self.seed = int(seed)
+        self.kill_at: Optional[int] = None
+        self.nan_at: Optional[int] = None
+        self.nan_frac = 0.01
+        self.write_fails = 0
+        self.corrupt_sidecar = False
+        self.truncate_model = False
+        self._fired = set()
+        for token in (t.strip() for t in self.spec.split(",")):
+            if not token:
+                continue
+            if token.startswith("kill@"):
+                self.kill_at = int(token[len("kill@"):])
+            elif token.startswith("nan_gh@"):
+                body = token[len("nan_gh@"):]
+                if ":" in body:
+                    it, frac = body.split(":", 1)
+                    self.nan_at, self.nan_frac = int(it), float(frac)
+                else:
+                    self.nan_at = int(body)
+            elif token.startswith("ckpt_write_fail:"):
+                self.write_fails = int(token.split(":", 1)[1])
+            elif token == "ckpt_corrupt":
+                self.corrupt_sidecar = True
+            elif token == "ckpt_truncate":
+                self.truncate_model = True
+            else:
+                Log.fatal("Unknown fault token %r in fault spec %r",
+                          token, self.spec)
+
+    def once(self, key: str) -> bool:
+        if key in self._fired:
+            return False
+        self._fired.add(key)
+        return True
+
+
+_plan: Optional[FaultPlan] = None
+
+
+def _get() -> FaultPlan:
+    global _plan
+    if _plan is None:
+        _plan = FaultPlan(os.environ.get("LGBM_TPU_FAULT", ""),
+                          int(os.environ.get("LGBM_TPU_FAULT_SEED", "0")))
+    return _plan
+
+
+def install(spec: str, seed: int = 0) -> FaultPlan:
+    """Arm a fault plan programmatically (tests)."""
+    global _plan
+    _plan = FaultPlan(spec, seed)
+    return _plan
+
+
+def clear() -> None:
+    """Disarm; the next hook re-reads the environment."""
+    global _plan
+    _plan = None
+
+
+# ------------------------------------------------------------------- hooks
+
+def check_kill(iteration: int) -> None:
+    """Injection point at the start of GBDT.train_one_iter."""
+    p = _get()
+    if p.kill_at is not None and iteration == p.kill_at and p.once("kill"):
+        raise InjectedFault(f"injected fault: kill at iteration {iteration}")
+
+
+def maybe_poison_gh(grads, hesses, iteration: int):
+    """Injection point after the gradient pass: NaN a seeded row subset of
+    the gh wave. One-shot, so a rollback's recomputed gradients are clean."""
+    p = _get()
+    if p.nan_at is None or iteration != p.nan_at or not p.once("nan_gh"):
+        return grads, hesses
+    import numpy as np
+
+    n = int(grads.shape[-1])
+    k = max(1, int(round(p.nan_frac * n)))
+    rng = np.random.RandomState(p.seed + iteration)
+    idx = np.sort(rng.choice(n, k, replace=False)).astype(np.int32)
+    Log.warning("Fault injection: poisoning %d/%d gradient rows with NaN "
+                "at iteration %d", k, n, iteration)
+    if grads.ndim == 1:
+        return grads.at[idx].set(float("nan")), hesses.at[idx].set(float("nan"))
+    return (grads.at[:, idx].set(float("nan")),
+            hesses.at[:, idx].set(float("nan")))
+
+
+def maybe_fail_write(path: str) -> None:
+    """Injection point inside the atomic writer's retry loop, before the
+    temp file exists — a transient host-side write failure."""
+    p = _get()
+    if p.write_fails > 0:
+        p.write_fails -= 1
+        raise OSError(f"injected fault: transient write failure for {path}")
+
+
+def maybe_corrupt_artifact(path: str) -> None:
+    """Injection point after an atomic write lands: corrupt the sidecar or
+    truncate the model text, simulating on-disk damage the loader must
+    detect (checksum / fail-fast parse) rather than crash on."""
+    p = _get()
+    is_sidecar = path.endswith(".ckpt")
+    if p.corrupt_sidecar and is_sidecar and p.once("corrupt"):
+        with open(path, "rb") as fh:
+            data = bytearray(fh.read())
+        mid = len(data) // 2
+        for i in range(mid, min(mid + 16, len(data))):
+            data[i] ^= 0xFF
+        with open(path, "wb") as fh:  # graftlint: disable=non-atomic-write -- fault injection deliberately damages the artifact in place
+            fh.write(bytes(data))
+        Log.warning("Fault injection: corrupted checkpoint sidecar %s", path)
+    elif p.truncate_model and not is_sidecar and p.once("truncate"):
+        size = os.path.getsize(path)
+        with open(path, "rb+") as fh:
+            fh.truncate(size // 2)
+        Log.warning("Fault injection: truncated %s to %d bytes",
+                    path, size // 2)

@@ -1,0 +1,31 @@
+"""R8 fixture: bare write-mode opens in a model-save path."""
+
+
+def save_bad(path, text):
+    with open(path, "w") as fh:  # fires: literal write mode, positional
+        fh.write(text)
+
+
+def save_bad_kw(path, data):
+    with open(path, mode="wb") as fh:  # fires: write mode via keyword
+        fh.write(data)
+
+
+def load_ok(path):
+    with open(path) as fh:  # clean: default read mode
+        return fh.read()
+
+
+def load_ok_explicit(path):
+    with open(path, "rb") as fh:  # clean: read mode
+        return fh.read()
+
+
+def save_dynamic(path, text, mode):
+    with open(path, mode) as fh:  # clean: non-literal mode, out of reach
+        fh.write(text)
+
+
+def save_suppressed(path, text):
+    with open(path, "w") as fh:  # graftlint: disable=non-atomic-write -- scratch debug dump, not a persistence artifact
+        fh.write(text)

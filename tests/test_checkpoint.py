@@ -1,0 +1,294 @@
+"""Crash-consistent checkpoint/resume: atomic writes, fail-fast loading of
+damaged model files, and BIT-IDENTICAL kill-and-resume across the trainer
+variants (plain, column-sampled, bagged mid-window, quantized, early-stop,
+and the sharded 8-fake-device learner).
+
+Bit-identity contract: train N straight vs. train k, snapshot, build a
+FRESH process-equivalent state (new Booster/GBDT), resume to N with the
+same command — the full model text (every float printed shortest-roundtrip)
+and raw predictions must be byte-equal.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.checkpoint import (CKPT_MAGIC, SIDECAR_SUFFIX, atomic_open,
+                                     atomic_write_text, load_checkpoint,
+                                     restore_trainer_state, save_checkpoint)
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.engine import train
+from lightgbm_tpu.io.dataset import Dataset as CoreDataset
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.models.serialize import GBDTModel
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _data(rng, n=500, f=10):
+    X = rng.standard_normal((n, f)).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] + rng.standard_normal(n) * 0.5 > 0)
+    return X, y.astype(np.float64)
+
+
+BASE = {"objective": "binary", "num_leaves": 7, "learning_rate": 0.1,
+        "verbosity": -1, "min_data_in_leaf": 5}
+
+
+def _train(params, X, y, rounds, init_model=None, valid=None, cbs=None):
+    vs = None
+    if valid is not None:
+        vs = [lgb.Dataset(valid[0], label=valid[1])]
+    return train(dict(params), lgb.Dataset(X, label=y),
+                 num_boost_round=rounds, init_model=init_model,
+                 valid_sets=vs, callbacks=cbs)
+
+
+def _resume_case(tmp_path, rng, params, rounds=6, snap_at=3):
+    """Train straight vs snapshot-at-k + resume with the same command;
+    return both boosters."""
+    X, y = _data(np.random.RandomState(7))
+    straight = _train(params, X, y, rounds)
+    half = _train(params, X, y, snap_at)
+    p = str(tmp_path / "snap.txt")
+    save_checkpoint(half, p)
+    resumed = _train(params, X, y, rounds, init_model=p)
+    return straight, resumed, X
+
+
+def _assert_bit_identical(straight, resumed, X):
+    assert straight.current_iteration() == resumed.current_iteration()
+    assert (straight.model_to_string(num_iteration=-1)
+            == resumed.model_to_string(num_iteration=-1))
+    np.testing.assert_array_equal(
+        np.asarray(straight.predict(X, raw_score=True)),
+        np.asarray(resumed.predict(X, raw_score=True)))
+
+
+# ----------------------------------------------------------- atomic writes
+
+def test_atomic_write_leaves_no_temp_files(tmp_path):
+    p = str(tmp_path / "out.txt")
+    atomic_write_text(p, "payload")
+    with open(p) as fh:
+        assert fh.read() == "payload"
+    assert os.listdir(tmp_path) == ["out.txt"]  # temp cleaned up
+
+
+def test_atomic_open_unlinks_temp_on_failure(tmp_path):
+    p = str(tmp_path / "out.txt")
+    with pytest.raises(RuntimeError):
+        with atomic_open(p, "w") as fh:
+            fh.write("partial")
+            raise RuntimeError("crash mid-write")
+    assert os.listdir(tmp_path) == []  # neither target nor temp remains
+
+
+def test_save_to_file_is_atomic(tmp_path, rng):
+    X, y = _data(rng)
+    bst = _train(BASE, X, y, 2)
+    p = str(tmp_path / "model.txt")
+    bst.save_model(p)
+    assert os.listdir(tmp_path) == ["model.txt"]
+    assert GBDTModel.from_file(p).num_iterations == 2
+
+
+# ------------------------------------------------- fail-fast damaged loads
+
+def test_truncated_model_file_fails_fast(tmp_path, rng):
+    X, y = _data(rng)
+    bst = _train(BASE, X, y, 3)
+    p = str(tmp_path / "model.txt")
+    bst.save_model(p)
+    size = os.path.getsize(p)
+    with open(p, "rb+") as fh:
+        fh.truncate(size // 2)
+    with pytest.raises(LightGBMError) as ei:
+        GBDTModel.from_file(p)
+    msg = str(ei.value)
+    assert "model.txt" in msg and "truncated or corrupt" in msg
+
+
+def test_garbled_header_names_key_and_file(tmp_path, rng):
+    X, y = _data(rng)
+    text = _train(BASE, X, y, 1).model_to_string()
+    p = str(tmp_path / "bad.txt")
+    with open(p, "w") as fh:
+        fh.write(text.replace("num_class=1", "num_class=banana"))
+    with pytest.raises(LightGBMError) as ei:
+        GBDTModel.from_file(p)
+    assert "bad.txt" in str(ei.value) and "garbled" in str(ei.value)
+
+
+def test_missing_header_key_fails_fast():
+    with pytest.raises(LightGBMError) as ei:
+        GBDTModel.from_string("tree\nversion=v4\n", source="mem.txt")
+    assert "num_class" in str(ei.value) and "mem.txt" in str(ei.value)
+
+
+# ------------------------------------------------------ resume bit-identity
+
+def test_resume_bit_identical_plain_with_col_sampling(tmp_path, rng):
+    params = {**BASE, "feature_fraction": 0.7}
+    _assert_bit_identical(*_resume_case(tmp_path, rng, params))
+
+
+def test_resume_bit_identical_bagged_mid_window(tmp_path, rng):
+    # snapshot at iteration 3 with bagging_freq=2: the bag in force was
+    # sampled at iteration 2 and must survive the resume (iteration 3
+    # REUSES it; resampling would diverge)
+    params = {**BASE, "bagging_fraction": 0.6, "bagging_freq": 2,
+              "feature_fraction": 0.8}
+    _assert_bit_identical(*_resume_case(tmp_path, rng, params, snap_at=3))
+
+
+def test_resume_bit_identical_quantized(tmp_path, rng):
+    # the per-tree PRNG split chain of the stochastic-rounding key must
+    # continue from the checkpointed key, not restart from the seed
+    params = {**BASE, "use_quantized_grad": True,
+              "quant_train_renew_leaf": True}
+    _assert_bit_identical(*_resume_case(tmp_path, rng, params))
+
+
+def test_resume_restores_early_stop_state(tmp_path):
+    rng = np.random.RandomState(7)
+    X, y = _data(rng, n=400)
+    Xv, yv = _data(np.random.RandomState(8), n=200)
+    params = {**BASE, "metric": "auc", "early_stopping_round": 2,
+              "learning_rate": 0.5, "num_leaves": 31, "min_data_in_leaf": 2}
+    straight = _train(params, X, y, 30, valid=(Xv, yv))
+    # a run long enough to early-stop well before 30
+    assert straight.current_iteration() < 30
+    snap_at = max(2, straight.best_iteration - 1)
+    half = _train(params, X, y, snap_at, valid=(Xv, yv))
+    p = str(tmp_path / "snap.txt")
+    save_checkpoint(half, p)
+    st = load_checkpoint(p)
+    assert st is not None and st.es is not None and st.es["enabled"]
+    resumed = _train(params, X, y, 30, init_model=p, valid=(Xv, yv))
+    assert resumed.best_iteration == straight.best_iteration
+    assert resumed.best_score["valid_0"] == straight.best_score["valid_0"]
+    assert (straight.model_to_string(num_iteration=-1)
+            == resumed.model_to_string(num_iteration=-1))
+
+
+def test_resume_bit_identical_sharded_8_devices(tmp_path):
+    """tree_learner=data on the fake 8-device mesh: every device holds a
+    shard of the restored state and the resumed run matches the straight
+    run bit for bit (trees are committed replicated, so equality of the
+    single exported model IS equality on all devices)."""
+    import jax
+
+    from lightgbm_tpu.parallel.learners import DeviceDataParallelTreeLearner
+
+    assert len(jax.devices()) == 8
+    rng = np.random.RandomState(11)
+    X, y = _data(rng, n=900, f=6)
+    params = {**BASE, "num_leaves": 7}
+
+    def _gbdt():
+        cfg = Config(dict(params))
+        ds = CoreDataset.from_matrix(X, label=y, config=cfg)
+        bst = GBDT(cfg, ds, create_objective("binary", cfg))
+        bst.tree_learner = DeviceDataParallelTreeLearner(cfg, ds)
+        return bst
+
+    straight = _gbdt()
+    for _ in range(6):
+        straight.train_one_iter()
+
+    half = _gbdt()
+    for _ in range(3):
+        half.train_one_iter()
+    p = str(tmp_path / "snap.txt")
+    save_checkpoint(half, p)
+
+    resumed = _gbdt()
+    st = load_checkpoint(p)
+    assert st is not None
+    assert st.learner["n_devices"] == 8
+    restore_trainer_state(resumed, st)
+    assert len(resumed.tree_learner.bins_dev.sharding.device_set) == 8
+    for _ in range(3):
+        resumed.train_one_iter()
+
+    assert (straight.to_model().to_string(num_iteration=-1)
+            == resumed.to_model().to_string(num_iteration=-1))
+    np.testing.assert_array_equal(
+        np.asarray(straight.predict(X, raw_score=True)),
+        np.asarray(resumed.predict(X, raw_score=True)))
+
+
+# --------------------------------------------------- sidecar invalidation
+
+def test_corrupt_sidecar_falls_back_to_plain_resume(tmp_path, rng, caplog):
+    X, y = _data(rng)
+    half = _train(BASE, X, y, 3)
+    p = str(tmp_path / "snap.txt")
+    save_checkpoint(half, p)
+    with open(p + SIDECAR_SUFFIX, "rb+") as fh:
+        fh.seek(64)
+        fh.write(b"\x00" * 16)
+    assert load_checkpoint(p) is None  # checksum catches the damage
+    # engine falls back to plain continued training: the loaded model seeds
+    # init_score and the fresh booster grows N NEW trees of its own
+    resumed = _train(BASE, X, y, 3, init_model=p)
+    assert resumed.current_iteration() == 3
+
+
+def test_model_edit_invalidates_sidecar(tmp_path, rng):
+    # the sidecar binds to the model text by content hash: touching the
+    # model file after the snapshot kills bit-identity claims, so the pair
+    # must be rejected
+    X, y = _data(rng)
+    half = _train(BASE, X, y, 3)
+    p = str(tmp_path / "snap.txt")
+    save_checkpoint(half, p)
+    with open(p, "a") as fh:  # graftlint not in scope: tests
+        fh.write("\n")
+    assert load_checkpoint(p) is None
+
+
+def test_missing_sidecar_is_silent_plain_resume(tmp_path, rng):
+    X, y = _data(rng)
+    half = _train(BASE, X, y, 3)
+    p = str(tmp_path / "model.txt")
+    half.save_model(p)
+    assert not os.path.exists(p + SIDECAR_SUFFIX)
+    assert load_checkpoint(p) is None
+    resumed = _train(BASE, X, y, 2, init_model=p)
+    assert resumed.current_iteration() == 2
+
+
+def test_manifest_contents(tmp_path, rng):
+    X, y = _data(rng)
+    half = _train({**BASE, "bagging_fraction": 0.6, "bagging_freq": 2},
+                  X, y, 4)
+    p = str(tmp_path / "snap.txt")
+    save_checkpoint(half, p)
+    with open(p + SIDECAR_SUFFIX, "rb") as fh:
+        assert fh.read(len(CKPT_MAGIC)) == CKPT_MAGIC
+    st = load_checkpoint(p)
+    assert st is not None
+    man = st.manifest
+    assert man["iteration"] == 4
+    assert man["boosting"] == "GBDT"
+    assert man["num_data"] == len(X)
+    assert json.dumps(man)  # manifest is pure JSON
+    assert st.score.shape == (1, len(X))
+    assert st.bag is not None and len(st.bag) < len(X)
+    assert "colsampler_keys" in st.learner
+    assert st.learner["colsampler_keys"].shape == (624,)
+
+
+def test_restore_refuses_dataset_mismatch(tmp_path, rng):
+    X, y = _data(rng)
+    half = _train(BASE, X, y, 2)
+    p = str(tmp_path / "snap.txt")
+    save_checkpoint(half, p)
+    X2, y2 = _data(np.random.RandomState(9), n=300)
+    with pytest.raises(LightGBMError) as ei:
+        _train(BASE, X2, y2, 4, init_model=p)
+    assert "refusing to resume" in str(ei.value)

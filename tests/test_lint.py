@@ -203,6 +203,27 @@ def test_r7_suppression_honored(fixture_result):
     assert len(sup) == 1 and "bound by the caller's shard_map" in sup[0].reason
 
 
+# -- R8 atomic-write discipline -------------------------------------------
+
+def test_r8_bare_write_opens_detected(fixture_result):
+    bad = _hits(fixture_result, "non-atomic-write", "models/r8_write.py")
+    assert [v.line for v in bad] == [5, 10]  # positional + mode= keyword
+    assert all("atomic" in v.message for v in bad)
+
+
+def test_r8_reads_and_dynamic_modes_are_clean(fixture_result):
+    lines = {v.line for v in
+             _hits(fixture_result, "non-atomic-write", "models/r8_write.py")
+             + _hits(fixture_result, "non-atomic-write", "models/r8_write.py",
+                     suppressed=True)}
+    assert not lines & {15, 20, 25}
+
+
+def test_r8_suppression_honored(fixture_result):
+    sup = _hits(fixture_result, "non-atomic-write", suppressed=True)
+    assert len(sup) == 1 and "scratch debug dump" in sup[0].reason
+
+
 # -- S1 directive hygiene -------------------------------------------------
 
 def test_s1_bad_directives_are_findings(fixture_result):
@@ -240,11 +261,12 @@ def test_ignore_filters_rules():
 
 def test_rule_codes_cover_names_and_codes():
     table = rule_codes()
-    for ident in ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "jit-donation",
-                  "jit-host-sync",
+    for ident in ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8",
+                  "jit-donation", "jit-host-sync",
                   "implicit-dtype", "pallas-tile-shape",
                   "pallas-prefetch-arity", "pallas-host-op",
-                  "param-unread", "untimed-hot-func", "collective-axis"):
+                  "param-unread", "untimed-hot-func", "collective-axis",
+                  "non-atomic-write"):
         assert ident in table
 
 

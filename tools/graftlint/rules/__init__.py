@@ -6,6 +6,7 @@ from __future__ import annotations
 
 from typing import Dict, List
 
+from .atomic_io import AtomicWriteRule
 from .base import Rule
 from .collective_axis import CollectiveAxisRule
 from .donation import DonationRule
@@ -23,6 +24,7 @@ RULES: List[Rule] = [
     TimerDisciplineRule(),
     DonationRule(),
     CollectiveAxisRule(),
+    AtomicWriteRule(),
 ]
 
 # rule name -> R-code for ids emitted by rules beyond their primary name

@@ -1,0 +1,70 @@
+"""R8 non-atomic-write: model/checkpoint artifacts must go through the
+atomic writer.
+
+The defect class this PR's checkpoint work exists to kill: a bare
+``open(path, "w")`` in a save path means a crash (or preemption — the TPU
+fleet's steady state) mid-write leaves a truncated model file that the next
+run trips over. ``lightgbm_tpu/checkpoint.py`` provides the one correct
+write primitive (temp file in the target directory + fsync + ``os.replace``
++ directory fsync, with bounded retry): ``atomic_open`` for streaming
+writers, ``atomic_write_text``/``atomic_write_bytes`` for whole-content
+writes. This rule flags any literal write-mode ``open()`` call in the
+modules that persist models, checkpoints, datasets, or converted artifacts
+— read-mode opens and non-literal modes pass.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ..core import Package, Violation, dotted_name, keyword_arg
+from .base import Rule
+
+_WRITE_CHARS = set("wax")
+
+
+def _literal_write_mode(call: ast.Call) -> Optional[str]:
+    """The call's literal mode string when it opens for writing, else None
+    (no mode = read; non-literal modes are out of static reach)."""
+    mode_node: Optional[ast.AST] = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    kw = keyword_arg(call, "mode")
+    if kw is not None:
+        mode_node = kw
+    if isinstance(mode_node, ast.Constant) and isinstance(mode_node.value, str):
+        if _WRITE_CHARS & set(mode_node.value):
+            return mode_node.value
+    return None
+
+
+class AtomicWriteRule(Rule):
+    name = "non-atomic-write"
+    code = "R8"
+    description = ("bare write-mode open() in a model/checkpoint/dataset "
+                   "save path — a crash mid-write leaves a truncated "
+                   "artifact; route it through checkpoint.atomic_open / "
+                   "atomic_write_text / atomic_write_bytes")
+    scope_prefixes = ("models/",)
+    scope_exact = ("checkpoint.py", "cli.py", "basic.py", "engine.py")
+
+    def check(self, pkg: Package) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for ctx in self.scoped(pkg):
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if dotted_name(node.func) != "open":
+                    continue
+                mode = _literal_write_mode(node)
+                if mode is None:
+                    continue
+                out.append(self.violation(
+                    ctx, node,
+                    "open(..., %r) writes a persistence artifact "
+                    "non-atomically — a crash here leaves a truncated "
+                    "file; use checkpoint.atomic_open (streaming) or "
+                    "checkpoint.atomic_write_text/bytes (whole content), "
+                    "which add temp+fsync+os.replace and bounded retry"
+                    % mode))
+        return out
