@@ -146,6 +146,7 @@ def _wave_traffic_fields(ds) -> dict:
 
 def run_bench(n_rows: int) -> dict:
     import lightgbm_tpu as lgb
+    from lightgbm_tpu import telemetry
 
     holdout = min(200_000, n_rows // 5)
     Xall, yall = make_data(n_rows + holdout)
@@ -160,42 +161,53 @@ def run_bench(n_rows: int) -> dict:
         "min_data_in_leaf": 100,
         "verbosity": -1,
     }
-    ds = lgb.Dataset(X, label=y)
-    bst = lgb.Booster(params=params, train_set=ds)
-    for _ in range(WARMUP_ITERS):  # compile + cache warmup, not timed
-        bst.update()
-    t0 = time.perf_counter()
-    for _ in range(N_ITERS):
-        bst.update()
-    elapsed = time.perf_counter() - t0
-    rips = n_rows * N_ITERS / elapsed
-    out = {"row_iters_per_sec": rips, "elapsed_s": elapsed, "rows": n_rows,
-           "iters": N_ITERS,
-           "auc": round(_auc(yh, bst.predict(Xh)), 4)}
-    out.update(_wave_traffic_fields(ds))
-
-    # inference throughput: chunked streaming predict over the train matrix
-    # (the serving configuration — double-buffered H2D/compute/D2H overlap)
-    from lightgbm_tpu.ops.partition import bucket_size
-
-    pred_chunk = min(1 << 20, bucket_size(max(n_rows // 4, 1), 1024))
-    bst.predict(X, raw_score=True, pred_chunk_rows=pred_chunk)  # compile warmup
-    t0 = time.perf_counter()
-    bst.predict(X, raw_score=True, pred_chunk_rows=pred_chunk)
-    pe = time.perf_counter() - t0
-    out["predict_rows_per_sec"] = round(n_rows / pe, 1)
-    out["predict_chunk_rows"] = pred_chunk
-
-    # robustness-layer cost: one full-state checkpoint write of the trained
-    # model (model text + sidecar, atomic + fsync) ...
-    import tempfile
-
-    from lightgbm_tpu.checkpoint import save_checkpoint
-
-    with tempfile.TemporaryDirectory() as td:
+    # aggregate-only telemetry session (no files): counts jit compiles and
+    # samples HBM high-water so the capture record attributes regressions
+    # (recompile churn vs memory pressure) instead of just restating them
+    telemetry.start(None, label="bench")
+    try:
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.Booster(params=params, train_set=ds)
+        for _ in range(WARMUP_ITERS):  # compile + cache warmup, not timed
+            bst.update()
         t0 = time.perf_counter()
-        save_checkpoint(bst, os.path.join(td, "bench_model.txt"))
-        out["checkpoint_write_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        for _ in range(N_ITERS):
+            bst.update()
+        elapsed = time.perf_counter() - t0
+        rips = n_rows * N_ITERS / elapsed
+        out = {"row_iters_per_sec": rips, "elapsed_s": elapsed,
+               "rows": n_rows, "iters": N_ITERS,
+               "auc": round(_auc(yh, bst.predict(Xh)), 4)}
+        out.update(_wave_traffic_fields(ds))
+
+        # inference throughput: chunked streaming predict over the train
+        # matrix (the serving configuration — double-buffered
+        # H2D/compute/D2H overlap)
+        from lightgbm_tpu.ops.partition import bucket_size
+
+        pred_chunk = min(1 << 20, bucket_size(max(n_rows // 4, 1), 1024))
+        bst.predict(X, raw_score=True, pred_chunk_rows=pred_chunk)  # warmup
+        t0 = time.perf_counter()
+        bst.predict(X, raw_score=True, pred_chunk_rows=pred_chunk)
+        pe = time.perf_counter() - t0
+        out["predict_rows_per_sec"] = round(n_rows / pe, 1)
+        out["predict_chunk_rows"] = pred_chunk
+
+        # robustness-layer cost: one full-state checkpoint write of the
+        # trained model (model text + sidecar, atomic + fsync) ...
+        import tempfile
+
+        from lightgbm_tpu.checkpoint import save_checkpoint
+
+        with tempfile.TemporaryDirectory() as td:
+            t0 = time.perf_counter()
+            save_checkpoint(bst, os.path.join(td, "bench_model.txt"))
+            out["checkpoint_write_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 2)
+    finally:
+        tel_summary = telemetry.stop()
+    out["compile_count"] = int(tel_summary["compile_count"])
+    out["hbm_high_water_bytes"] = int(tel_summary["hbm_high_water_bytes"])
 
     # ... and the numerical-health guardrail at its most expensive setting
     # (policy=warn, sync every iteration) vs the same short train without it
@@ -216,6 +228,16 @@ def run_bench(n_rows: int) -> dict:
     guard_s = _short_train({"health_check_policy": "warn",
                             "health_check_every": 1})
     out["guardrail_overhead_pct"] = round((guard_s / base_s - 1.0) * 100.0, 2)
+
+    # ... and the telemetry stack at full tilt (file sinks + watchers + span
+    # capture) vs the same short train with it off — the <1% overhead claim,
+    # measured on every capture (can be negative on noisy hosts)
+    with tempfile.TemporaryDirectory() as tel_td:
+        from lightgbm_tpu import telemetry as _tel
+
+        with _tel.capture(tel_td, label="bench-overhead"):
+            tel_s = _short_train({})
+    out["telemetry_overhead_pct"] = round((tel_s / base_s - 1.0) * 100.0, 2)
 
     # secondary quantized capture defaults ON only at moderate sizes — at
     # full HIGGS scale it would double the remote-compile + train time and
@@ -284,7 +306,8 @@ def main() -> None:
                       "quantized_error", "device_hist_rows",
                       "est_carried_bytes_per_wave", "predict_rows_per_sec",
                       "predict_chunk_rows", "checkpoint_write_ms",
-                      "guardrail_overhead_pct"):
+                      "guardrail_overhead_pct", "compile_count",
+                      "hbm_high_water_bytes", "telemetry_overhead_pct"):
                 if k in res:
                     record[k] = res[k]
             emit(record)

@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
 
+from . import telemetry
 from .utils import faults
 from .utils.log import Log
 from .utils.timer import global_timer
@@ -158,6 +159,8 @@ def save_checkpoint(booster, path: str, retries: int = 3) -> None:
                 Log.warning("Checkpoint for boosting type %s saves model "
                             "text only; resume will not be bit-identical",
                             type(gbdt).__name__)
+            telemetry.emit("checkpoint", path=path, model_only=True,
+                           iteration=int(gbdt.iter_))
             return
         arrays: Dict[str, np.ndarray] = {"score": np.asarray(gbdt.score)}
         for i, vd in enumerate(gbdt.valid_sets):
@@ -197,6 +200,8 @@ def save_checkpoint(booster, path: str, retries: int = 3) -> None:
         payload = buf.getvalue()
         blob = CKPT_MAGIC + hashlib.sha256(payload).digest() + payload
         atomic_write_bytes(path + SIDECAR_SUFFIX, blob, retries=retries)
+    telemetry.emit("checkpoint", path=path, model_only=False,
+                   iteration=int(gbdt.iter_), sidecar_bytes=len(blob))
 
 
 def load_checkpoint(path: str) -> Optional[TrainerState]:
@@ -303,6 +308,8 @@ def restore_trainer_state(booster, state: TrainerState,
     gbdt._predictor.invalidate()
     Log.info("Resumed trainer state from checkpoint: iteration %d, %d trees",
              gbdt.iter_, len(gbdt.models))
+    telemetry.emit("checkpoint_resume", iteration=int(state.iteration),
+                   num_trees=len(gbdt.models))
     return int(state.iteration)
 
 

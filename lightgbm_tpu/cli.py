@@ -6,6 +6,11 @@ same `key=value` arguments and `config=train.conf` files as the reference CLI
 so reference example configs run unchanged:
 
     python -m lightgbm_tpu.cli config=examples/binary_classification/train.conf
+
+Observability: pass `telemetry_dir=<dir>` (or set LGBM_TPU_TELEMETRY=<dir>)
+to record the structured per-iteration event stream plus a Perfetto-loadable
+Chrome trace for the run; summarize or diff runs with tools/teldiff.py
+(docs/OBSERVABILITY.md).
 """
 from __future__ import annotations
 

@@ -8,12 +8,14 @@ from __future__ import annotations
 
 import collections
 import copy
+import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Union
 
 import numpy as np
 
 from . import callback as callback_mod
 from . import checkpoint as checkpoint_mod
+from . import telemetry
 from .basic import Booster, Dataset
 from .callback import CallbackEnv, EarlyStopException
 from .config import key_alias_transform
@@ -47,6 +49,27 @@ def train(params: Dict[str, Any], train_set: Dataset,
           keep_training_booster: bool = False,
           callbacks: Optional[List[Callable]] = None) -> Booster:
     params = key_alias_transform(params or {})
+    # fresh wall-clock window per run: back-to-back train() calls in one
+    # process stop conflating totals (work counters survive — see timer.py)
+    global_timer.new_epoch()
+    # telemetry session from the `telemetry_dir` param / $LGBM_TPU_TELEMETRY;
+    # a session already active (e.g. bench.py's) is left alone and reused
+    own_tel = None
+    tel_dir = telemetry.resolve_dir(params)
+    if tel_dir and telemetry.session() is None:
+        own_tel = telemetry.start(tel_dir, label="train")
+    try:
+        return _train_impl(params, train_set, num_boost_round, valid_sets,
+                           valid_names, feval, init_model,
+                           keep_training_booster, callbacks)
+    finally:
+        if own_tel is not None:
+            telemetry.stop()
+
+
+def _train_impl(params, train_set, num_boost_round, valid_sets, valid_names,
+                feval, init_model, keep_training_booster,
+                callbacks) -> Booster:
     # num_boost_round param aliases override the argument (engine.py:158-170)
     if "num_iterations" in params:
         num_boost_round = int(params.pop("num_iterations"))
@@ -162,9 +185,16 @@ def _train_loop_inner(booster, params, feval, fobj, init_iteration,
                       callbacks_after) -> bool:
     is_finished = False
     evaluation_result_list = None
+    if telemetry.enabled():
+        telemetry.emit("train_begin", begin_iteration=init_iteration,
+                       end_iteration=init_iteration + num_boost_round,
+                       objective=str(params.get("objective", "")))
     for i in range(init_iteration, init_iteration + num_boost_round):
         if is_finished:
             break
+        it_t0 = time.perf_counter()
+        counters_before = (dict(global_timer.counters)
+                           if telemetry.enabled() else None)
         for cb in callbacks_before:
             cb(CallbackEnv(model=booster, params=params, iteration=i,
                            begin_iteration=init_iteration,
@@ -187,10 +217,35 @@ def _train_loop_inner(booster, params, feval, fobj, init_iteration,
             booster.best_iteration = earlyStopException.best_iteration + 1
             evaluation_result_list = earlyStopException.best_score
             is_finished = True
+        if counters_before is not None:
+            _emit_iteration_record(booster, i, evaluation_result_list,
+                                   time.perf_counter() - it_t0,
+                                   counters_before)
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for item in evaluation_result_list or []:
         booster.best_score[item[0]][item[1]] = item[2]
     return is_finished
+
+
+def _emit_iteration_record(booster, iteration, evals, wall_s,
+                           counters_before) -> None:
+    """One structured record per boosting iteration: eval results, tree
+    stats, work-counter deltas, wall time — plus an HBM gauge sample."""
+    gbdt = getattr(booster, "_gbdt", None)
+    models = getattr(gbdt, "models", None) or []
+    last = models[-1] if models else None
+    deltas = {}
+    for k, v in global_timer.counters.items():
+        d = int(v) - int(counters_before.get(k, 0))
+        if d:
+            deltas[k] = d
+    telemetry.emit(
+        "iteration", iteration=int(iteration), wall_s=round(wall_s, 6),
+        num_trees=len(models),
+        tree_leaves=int(getattr(last, "num_leaves", 0) or 0),
+        evals=[[e[0], e[1], float(e[2])] for e in (evals or [])],
+        counters=deltas)
+    telemetry.sample_hbm()
 
 
 def _wants_train_metric(params) -> bool:

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+from . import telemetry
 from .utils.log import Log
 
 _POLICIES = ("fatal", "warn", "rollback")
@@ -96,6 +97,8 @@ class HealthMonitor:
             self._acc = None
             self._host_ok = True
             self._since_sync = 0
+            telemetry.emit("health_check", healthy=bool(healthy),
+                           policy=self.policy, iteration=int(gbdt.iter_))
             if not healthy:
                 grads, hesses = self._handle(gbdt, grads, hesses)
             elif self.policy == "rollback":
@@ -123,6 +126,8 @@ class HealthMonitor:
         Log.warning("Numerical health check failed at iteration %d; rolled "
                     "back %d iteration(s) to %d and re-boosting with "
                     "clipped gradients", it, rolled, int(gbdt.iter_))
+        telemetry.emit("health_rollback", iteration=it,
+                       rolled_back=int(rolled), resumed_at=int(gbdt.iter_))
         self.clip_on = True
         if gbdt._grad_fn is not None:
             score = gbdt.score if gbdt.num_tree_per_iteration > 1 \

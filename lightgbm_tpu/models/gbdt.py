@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
 from ..config import Config
 from ..health import create_monitor
 from ..io.dataset import Dataset
@@ -382,6 +383,8 @@ class GBDT:
                 del self.models[-C:]
             return True
         self.iter_ += 1
+        if telemetry.enabled():
+            telemetry.sample_hbm()  # per-tree HBM high-water
         return False
 
     def _train_one_iter_async(self, grads: jax.Array,

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
 from ..common import MISSING_NAN, MISSING_ZERO, K_ZERO_THRESHOLD
 from ..models.tree import Tree
 from ..utils.log import Log
@@ -465,6 +466,8 @@ def predict_raw_streamed(packed: PackedEnsemble, X: np.ndarray,
             xd = jnp.asarray(xc, dtype=dtype)
             yd = predict_raw(packed, xd, num_tree_per_iteration)
             yd.copy_to_host_async()
+            if telemetry.enabled():
+                telemetry.emit("predict_chunk", index=i, rows=rows, pad=pad)
             inflight.append((i, rows, yd))
             while len(inflight) > 2:
                 j, r, y = inflight.popleft()

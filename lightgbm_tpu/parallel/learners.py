@@ -569,18 +569,18 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         self._record_carry_bytes()
         self._record_ici_bytes(narrow)
         with global_timer.scope("tree_device"):
-            rec_store, leaf_id, _, hist_rows = self._grow_fn(
+            rec_store, leaf_id, _, hist_rows, n_waves = self._grow_fn(
                 bag_indices is not None, narrow)(
                 jnp.copy(self.bins_dev), gh_sh, leaf_sh, self._gidx_rep,
                 self._vslot_rep, self.scan_meta_sharded, self._tables_rep,
                 self._params_rep, fmask_sh, scale_rep)
         leaf_id = leaf_id[:n]
-        for arr in (rec_store, leaf_id, hist_rows):
+        for arr in (rec_store, leaf_id, hist_rows, n_waves):
             start = getattr(arr, "copy_to_host_async", None)
             if start is not None:
                 start()
         return _PendingTree(Tree(cfg.num_leaves), rec_store, leaf_id,
-                            hist_rows, n_bag)
+                            hist_rows, n_waves, n_bag)
 
     def _renew_quantized_leaves_device(self, tree: Tree,
                                        leaf_id: jax.Array) -> None:

@@ -386,10 +386,11 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
     def wave(carry):
         if sharded:
             (bins_p, row_p, start, count, depth, leaf_best, rec_store, pool,
-             n_cur, t, hist_rows, tpool, count_g) = carry
+             n_cur, t, hist_rows, tpool, count_g, n_waves) = carry
         else:
             (bins_p, row_p, start, count, depth, leaf_best, rec_store, pool,
-             n_cur, t, hist_rows) = carry
+             n_cur, t, hist_rows, n_waves) = carry
+        n_waves = n_waves + 1  # wave-efficiency telemetry (finalize())
         gains = leaf_best[:L, 0]
         sel_gain, sel = jax.lax.top_k(gains, K)  # [K] distinct leaves
         sel = sel.astype(jnp.int32)
@@ -601,9 +602,10 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         row_p = row_p.at[:, LEAF_COL].set(leafcol)
         if sharded:
             return (bins_p, row_p, start, count, depth, leaf_best,
-                    rec_store, pool, n_cur, t, hist_rows, tpool, count_g)
+                    rec_store, pool, n_cur, t, hist_rows, tpool, count_g,
+                    n_waves)
         return (bins_p, row_p, start, count, depth, leaf_best, rec_store,
-                pool, n_cur, t, hist_rows)
+                pool, n_cur, t, hist_rows, n_waves)
 
     def cond(carry):
         leaf_best, t = carry[5], carry[9]
@@ -613,10 +615,12 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
              jnp.int32(1), jnp.int32(0), hist_rows)
     if sharded:
         carry = carry + (tpool, count_g)
+    carry = carry + (jnp.int32(0),)  # n_waves, last so indices above hold
     if L > 1:
         carry = jax.lax.while_loop(cond, wave, carry)
     row_p, rec_store, n_cur, hist_rows = carry[1], carry[6], carry[8], \
         carry[10]
+    n_waves = carry[-1]
     if sharded:
         hist_rows = jax.lax.psum(hist_rows, "data")
     # undo the permutation without a TPU scatter: sort leaf ids by the
@@ -624,7 +628,7 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
     _, leaf_sorted = jax.lax.sort_key_val(
         row_p[:, POS_COL].astype(jnp.int32),
         row_p[:, LEAF_COL].astype(jnp.int32))
-    return rec_store[:-1], leaf_sorted[:N], n_cur, hist_rows
+    return rec_store[:-1], leaf_sorted[:N], n_cur, hist_rows, n_waves
 
 
 # bins/gh/leaf_id0 are donated: each is a fresh per-tree buffer (the
@@ -666,7 +670,8 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
     per tree: N (root) + sum over waves of the selected smaller-child rows
     — <= ~4N in practice vs O(N * waves) for full-N masked waves.
     Returns (rec_store [L-1, STORE], leaf_id [N] in ORIGINAL row order,
-    num_leaves_final, hist_rows — rows histogrammed, the perf counter).
+    num_leaves_final, hist_rows — rows histogrammed, the perf counter,
+    n_waves — while_loop trips, for the committed-vs-speculated telemetry).
     """
     return _grow_impl(bins, gh, leaf_id0, meta, tables, params, feature_mask,
                       scale_vec, num_leaves=num_leaves, num_bins=num_bins,
@@ -694,8 +699,9 @@ def make_sharded_grow_fn(mesh, *, num_leaves: int, num_bins: int,
     scale_vec must be a real array even when quantized=False (pass ones —
     it is ignored). Categorical splits are not supported here (the factory
     routes categorical configs to the host-driven learners). Returns the
-    same (rec_store, leaf_id [Np] global original order, n_cur, hist_rows)
-    as grow_tree_on_device; rec_store/n_cur/hist_rows are replicated.
+    same (rec_store, leaf_id [Np] global original order, n_cur, hist_rows,
+    n_waves) as grow_tree_on_device; rec_store/n_cur/hist_rows/n_waves are
+    replicated.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -714,7 +720,7 @@ def make_sharded_grow_fn(mesh, *, num_leaves: int, num_bins: int,
         body, mesh=mesh,
         in_specs=(P(None, "data"), P("data"), P("data"), P(), P(),
                   P("data"), P(), P(), P("data"), P()),
-        out_specs=(P(), P("data"), P(), P()),
+        out_specs=(P(), P("data"), P(), P(), P()),
         check_vma=False), donate_argnums=(0, 1, 2))
 
 
@@ -769,6 +775,7 @@ class _PendingTree(NamedTuple):
     rec_store: jax.Array
     leaf_id: jax.Array
     hist_rows: jax.Array
+    n_waves: jax.Array
     n_bag: int
 
 
@@ -871,7 +878,7 @@ class DeviceTreeLearner(SerialTreeLearner):
         with global_timer.scope("tree_device"):
             # bins_dev is COPIED per tree: grow_tree_on_device donates its
             # first three args (gh and leaf_id0 are already fresh buffers)
-            rec_store, leaf_id, _, hist_rows = grow_tree_on_device(
+            rec_store, leaf_id, _, hist_rows, n_waves = grow_tree_on_device(
                 jnp.copy(self.bins_dev), gh, leaf_id0, self.meta,
                 self.tables, self.params_dev, fmask, num_leaves,
                 self.group_bin_padded,
@@ -881,12 +888,12 @@ class DeviceTreeLearner(SerialTreeLearner):
         # start the device->host copies without blocking; finalize() (maybe
         # a full iteration later, under the async pipeline) pays no wait if
         # the transfer already landed
-        for arr in (rec_store, leaf_id, hist_rows):
+        for arr in (rec_store, leaf_id, hist_rows, n_waves):
             start = getattr(arr, "copy_to_host_async", None)
             if start is not None:
                 start()
         return _PendingTree(Tree(num_leaves), rec_store, leaf_id, hist_rows,
-                            n_bag)
+                            n_waves, n_bag)
 
     def finalize(self, pending: _PendingTree) -> Tree:
         cfg = self.config
@@ -920,12 +927,38 @@ class DeviceTreeLearner(SerialTreeLearner):
             counts[leaf] = split.left_count
             counts[tree.num_leaves - 1] = split.right_count
 
+        self._record_wave_efficiency(pending, tree)
         self.partition = DevicePartition(leaf_id, counts)
         if tree.num_leaves == 1:
             tree.as_constant_tree(0.0)
         elif self.quantized and cfg.quant_train_renew_leaf:
             self._renew_quantized_leaves_device(tree, leaf_id)
         return tree
+
+    def _record_wave_efficiency(self, pending: _PendingTree,
+                                tree: Tree) -> None:
+        """Committed-vs-speculated wave accounting: each wave partitions +
+        histograms K candidate splits but the replay commits only as many
+        as stay globally best-first — the measured ratio is the input the
+        gain-adaptive wave-width work needs (ROADMAP item 1)."""
+        from .. import telemetry
+        n_waves = int(pending.n_waves)
+        committed = tree.num_leaves - 1
+        speculated = n_waves * self.wave
+        global_timer.add_count("device_waves", n_waves)
+        global_timer.add_count("wave_splits_committed", committed)
+        global_timer.add_count("wave_splits_speculated", speculated)
+        if telemetry.enabled():
+            telemetry.emit(
+                "tree_wave", waves=n_waves, wave_width=self.wave,
+                committed=committed, speculated=speculated,
+                efficiency=round(committed / speculated, 4) if speculated
+                else 1.0,
+                hist_rows=self.last_hist_rows,
+                ici_bytes_per_wave=int(global_timer.counters.get(
+                    "device_ici_bytes_per_wave", 0)),
+                carry_bytes_per_wave=int(global_timer.counters.get(
+                    "device_carry_bytes_per_wave", 0)))
 
     def _renew_quantized_leaves_device(self, tree: Tree,
                                        leaf_id: jax.Array) -> None:

@@ -105,6 +105,7 @@ def check_kill(iteration: int) -> None:
     """Injection point at the start of GBDT.train_one_iter."""
     p = _get()
     if p.kill_at is not None and iteration == p.kill_at and p.once("kill"):
+        _emit_fault("kill", iteration=iteration)
         raise InjectedFault(f"injected fault: kill at iteration {iteration}")
 
 
@@ -122,6 +123,7 @@ def maybe_poison_gh(grads, hesses, iteration: int):
     idx = np.sort(rng.choice(n, k, replace=False)).astype(np.int32)
     Log.warning("Fault injection: poisoning %d/%d gradient rows with NaN "
                 "at iteration %d", k, n, iteration)
+    _emit_fault("nan_gh", iteration=iteration, rows=k)
     if grads.ndim == 1:
         return grads.at[idx].set(float("nan")), hesses.at[idx].set(float("nan"))
     return (grads.at[:, idx].set(float("nan")),
@@ -134,6 +136,7 @@ def maybe_fail_write(path: str) -> None:
     p = _get()
     if p.write_fails > 0:
         p.write_fails -= 1
+        _emit_fault("write_fail", path=path)
         raise OSError(f"injected fault: transient write failure for {path}")
 
 
@@ -152,9 +155,18 @@ def maybe_corrupt_artifact(path: str) -> None:
         with open(path, "wb") as fh:  # graftlint: disable=non-atomic-write -- fault injection deliberately damages the artifact in place
             fh.write(bytes(data))
         Log.warning("Fault injection: corrupted checkpoint sidecar %s", path)
+        _emit_fault("corrupt", path=path)
     elif p.truncate_model and not is_sidecar and p.once("truncate"):
         size = os.path.getsize(path)
         with open(path, "rb+") as fh:
             fh.truncate(size // 2)
         Log.warning("Fault injection: truncated %s to %d bytes",
                     path, size // 2)
+        _emit_fault("truncate", path=path)
+
+
+def _emit_fault(kind: str, **fields) -> None:
+    """Record the injection in the telemetry stream (lazy import: this
+    module loads before the package's telemetry module in some paths)."""
+    from .. import telemetry
+    telemetry.emit("fault", kind=kind, **fields)

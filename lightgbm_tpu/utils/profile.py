@@ -35,13 +35,35 @@ def maybe_trace(trace_dir: Optional[str] = None):
     if not target:
         yield
         return
+    _check_writable(target)
     import jax
 
     Log.info("Profiling to %s (load with TensorBoard's profile plugin)",
              target)
-    with jax.profiler.trace(target):
-        yield
-    Log.info("Profile written to %s", target)
+    try:
+        with jax.profiler.trace(target):
+            yield
+    finally:
+        # the partial profile of a crashed run is often the most useful
+        # artifact it leaves behind — always say where it landed
+        Log.info("Profile written to %s", target)
+
+
+def _check_writable(target: str) -> None:
+    """Fail fast with a named invariant instead of the deep TraceMe/XLA
+    traceback jax.profiler.trace raises mid-run on an unwritable target."""
+    probe = target
+    while probe and not os.path.exists(probe):
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            break
+        probe = parent
+    if os.path.isfile(target):
+        Log.fatal("Profile target %s is a file, not a directory", target)
+    if not probe or not os.access(probe, os.W_OK):
+        Log.fatal("Profile target %s is not writable (nearest existing "
+                  "ancestor: %s) — fix LGBM_TPU_PROFILE or the trace_dir "
+                  "argument", target, probe or "<none>")
 
 
 def annotate(name: str):

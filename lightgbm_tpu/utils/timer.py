@@ -5,14 +5,22 @@ Counterpart of the reference's Common::Timer/FunctionTimer RAII scopes
 at exit under -DUSE_TIMETAG. Here: a context-manager / decorator that
 accumulates per-label seconds, plus jax.profiler trace annotation so the same
 labels appear in TPU traces.
+
+The timer doubles as the span source for the structured telemetry stack
+(lightgbm_tpu/telemetry.py): a session installs `span_hook`, every closed
+scope reports (label, start, end) to it, and the Chrome-trace exporter turns
+those into B/E span events. `new_epoch()` gives each engine.train() call a
+fresh accumulation window so back-to-back runs in one process stop
+conflating totals (counters survive — perf tests read them after train).
 """
 from __future__ import annotations
 
 import contextlib
+import functools
 import os
 import time
 from collections import defaultdict
-from typing import Dict, Iterator
+from typing import Callable, Dict, Iterator, Optional
 
 
 class GlobalTimer:
@@ -20,7 +28,14 @@ class GlobalTimer:
         self.totals: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
         self.counters: Dict[str, int] = defaultdict(int)
+        # labels published via set_count (levels, not accumulations) — lets
+        # telemetry report gauges absolute and accumulators as deltas
+        self.gauges: set = set()
         self.enabled = bool(os.environ.get("LGBM_TPU_TIMETAG"))
+        self.epoch = 0
+        # telemetry sink: called as span_hook(label, t0, t1) on every closed
+        # scope (perf_counter seconds). None when no session is recording.
+        self.span_hook: Optional[Callable[[str, float, float], None]] = None
 
     @contextlib.contextmanager
     def scope(self, label: str) -> Iterator[None]:
@@ -36,8 +51,11 @@ class GlobalTimer:
         start = time.perf_counter()
         with ctx:
             yield
-        self.totals[label] += time.perf_counter() - start
+        end = time.perf_counter()
+        self.totals[label] += end - start
         self.counts[label] += 1
+        if self.span_hook is not None:
+            self.span_hook(label, start, end)
 
     def add_count(self, label: str, n: int) -> None:
         """Accumulate a work counter (rows histogrammed, bytes moved, ...).
@@ -53,10 +71,12 @@ class GlobalTimer:
         so per-tree code can re-publish a static figure — e.g. the device
         learner's `device_carry_bytes_per_wave` — without inflating it."""
         self.counters[label] = int(n)
+        self.gauges.add(label)
 
     def report(self) -> str:
         lines = ["LightGBM-TPU timer summary:"]
-        for label in sorted(self.totals, key=self.totals.get, reverse=True):
+        # deterministic: totals descending, equal totals tie-broken by label
+        for label in sorted(self.totals, key=lambda k: (-self.totals[k], k)):
             lines.append(f"  {label}: {self.totals[label]:.3f}s ({self.counts[label]} calls)")
         for label in sorted(self.counters):
             lines.append(f"  {label}: {self.counters[label]}")
@@ -67,6 +87,16 @@ class GlobalTimer:
         self.counts.clear()
         self.counters.clear()
 
+    def new_epoch(self) -> int:
+        """Start a fresh per-run accumulation window: wall-clock totals and
+        call counts reset; work counters SURVIVE (bench.py and the learner
+        perf tests read them after training returns). Returns the new epoch
+        id so telemetry records can name the run they belong to."""
+        self.totals.clear()
+        self.counts.clear()
+        self.epoch += 1
+        return self.epoch
+
 
 global_timer = GlobalTimer()
 
@@ -75,11 +105,11 @@ def timed(label: str):
     """Decorator form of global_timer.scope."""
 
     def deco(fn):
+        @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             with global_timer.scope(label):
                 return fn(*args, **kwargs)
 
-        wrapper.__name__ = getattr(fn, "__name__", "timed")
         return wrapper
 
     return deco

@@ -1,0 +1,32 @@
+"""R9 fixture: hot-path telemetry.emit must sit under an enabled-guard."""
+from .. import telemetry
+from ..utils.timer import global_timer
+
+
+def unguarded_emit(committed, speculated):
+    telemetry.emit("tree_wave", committed=committed,  # line 7: VIOLATION
+                   speculated=speculated)
+
+
+def guarded_emit(committed, speculated):
+    if telemetry.enabled():  # idiomatic guard: clean
+        telemetry.emit("tree_wave", committed=committed,
+                       speculated=speculated)
+
+
+def guarded_ternary(rows):
+    return telemetry.emit("chunk", rows=rows) if telemetry.enabled() else None
+
+
+def counter_only(committed):
+    # always-cheap counter API needs no guard: clean
+    global_timer.add_count("wave_splits_committed", committed)
+
+
+def unrelated_emit(handler, record):
+    handler.emit(record)  # bare .emit on a non-telemetry object: clean
+
+
+def suppressed_emit(path):
+    # graftlint: disable=telemetry-hygiene -- fixture: cold error path, runs once
+    telemetry.emit("write_fail", path=path)

@@ -224,6 +224,28 @@ def test_r8_suppression_honored(fixture_result):
     assert len(sup) == 1 and "scratch debug dump" in sup[0].reason
 
 
+# -- R9 telemetry hygiene -------------------------------------------------
+
+def test_r9_unguarded_emit_detected(fixture_result):
+    bad = _hits(fixture_result, "telemetry-hygiene",
+                "treelearner/r9_telemetry.py")
+    assert [v.line for v in bad] == [7]
+    assert "enabled" in bad[0].message
+
+
+def test_r9_guards_counters_and_foreign_emit_are_clean(fixture_result):
+    lines = {v.line for v in
+             _hits(fixture_result, "telemetry-hygiene")
+             + _hits(fixture_result, "telemetry-hygiene", suppressed=True)}
+    # if-guard (13), ternary guard (18), counter API (23), handler.emit (27)
+    assert not lines & {13, 18, 23, 27}
+
+
+def test_r9_suppression_honored(fixture_result):
+    sup = _hits(fixture_result, "telemetry-hygiene", suppressed=True)
+    assert len(sup) == 1 and "cold error path" in sup[0].reason
+
+
 # -- S1 directive hygiene -------------------------------------------------
 
 def test_s1_bad_directives_are_findings(fixture_result):
@@ -261,12 +283,12 @@ def test_ignore_filters_rules():
 
 def test_rule_codes_cover_names_and_codes():
     table = rule_codes()
-    for ident in ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8",
+    for ident in ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9",
                   "jit-donation", "jit-host-sync",
                   "implicit-dtype", "pallas-tile-shape",
                   "pallas-prefetch-arity", "pallas-host-op",
                   "param-unread", "untimed-hot-func", "collective-axis",
-                  "non-atomic-write"):
+                  "non-atomic-write", "telemetry-hygiene"):
         assert ident in table
 
 

@@ -14,6 +14,7 @@ from .dtype_discipline import DtypeDisciplineRule
 from .jit_boundary import JitBoundaryRule
 from .pallas_rules import PallasRule
 from .param_consistency import ParamConsistencyRule
+from .telemetry_hygiene import TelemetryHygieneRule
 from .timer_discipline import TimerDisciplineRule
 
 RULES: List[Rule] = [
@@ -25,6 +26,7 @@ RULES: List[Rule] = [
     DonationRule(),
     CollectiveAxisRule(),
     AtomicWriteRule(),
+    TelemetryHygieneRule(),
 ]
 
 # rule name -> R-code for ids emitted by rules beyond their primary name

@@ -1,0 +1,256 @@
+#!/usr/bin/env python3
+"""Summarize or diff lightgbm_tpu telemetry runs (stdlib only).
+
+A telemetry run directory (written by lightgbm_tpu/telemetry.py when
+`telemetry_dir` / $LGBM_TPU_TELEMETRY is set) holds:
+
+    events.jsonl   one JSON object per line; the final `session_end` record
+                   carries the per-label timer totals, work counters, and
+                   watcher summaries this tool reads
+    trace.json     Chrome trace-event JSON (Perfetto / chrome://tracing)
+
+Usage:
+
+    python tools/teldiff.py summarize RUN_DIR
+    python tools/teldiff.py diff BASE_DIR CAND_DIR [--threshold PCT]
+    python tools/teldiff.py --self-check RUN_DIR
+
+`diff` prints per-label time and counter deltas and exits nonzero when any
+tracked figure regresses by more than --threshold percent (default 10) —
+the machine check "bench before/after" needs. `--self-check` validates a
+run's artifacts (parseable JSONL, required event types, monotonic trace
+timestamps, matched B/E span pairs) and exits nonzero on any violation —
+CI runs it on the smoke-train artifact.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+EVENTS_FILE = "events.jsonl"
+TRACE_FILE = "trace.json"
+# counters where a higher value is a regression (time-like figures always
+# regress upward); everything else is reported but never gates the exit code
+REGRESSION_COUNTERS = (
+    "jit_compiles",
+    "hbm_high_water_bytes",
+    "device_hist_rows",
+    "device_ici_bytes_per_wave",
+    "device_carry_bytes_per_wave",
+    "wave_splits_speculated",
+    "device_waves",
+)
+
+
+def _read_events(run_dir: str) -> List[Dict[str, Any]]:
+    path = os.path.join(run_dir, EVENTS_FILE)
+    if not os.path.isfile(path):
+        sys.exit(f"teldiff: no {EVENTS_FILE} in {run_dir}")
+    events = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                sys.exit(f"teldiff: {path}:{ln}: invalid JSON ({e})")
+    if not events:
+        sys.exit(f"teldiff: {path} is empty")
+    return events
+
+
+def _session_end(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    for ev in reversed(events):
+        if ev.get("ev") == "session_end":
+            return ev
+    sys.exit("teldiff: no session_end record — run did not close cleanly")
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
+def summarize(run_dir: str) -> int:
+    events = _read_events(run_dir)
+    end = _session_end(events)
+    iters = [e for e in events if e.get("ev") == "iteration"]
+    print(f"run: {run_dir}")
+    print(f"label: {end.get('label')}  duration: {end.get('duration_s')}s  "
+          f"events: {sum(end.get('events', {}).values())}  "
+          f"iterations: {len(iters)}")
+    if end.get("compile_count"):
+        print(f"jit compiles: {end['compile_count']}")
+    if end.get("hbm_high_water_bytes"):
+        print("hbm high water: "
+              f"{_fmt_bytes(end['hbm_high_water_bytes'])}")
+    totals = end.get("timer_totals", {})
+    counts = end.get("timer_counts", {})
+    if totals:
+        print("timer totals:")
+        for label in sorted(totals, key=lambda k: (-totals[k], k)):
+            print(f"  {label:<24} {totals[label]:>10.3f}s "
+                  f"({counts.get(label, 0)} calls)")
+    counters = end.get("counters", {})
+    if counters:
+        print("counters:")
+        for label in sorted(counters):
+            print(f"  {label:<32} {counters[label]}")
+    if iters:
+        walls = sorted(e.get("wall_s", 0.0) for e in iters)
+        mid = walls[len(walls) // 2]
+        print(f"per-iteration wall: median {mid:.4f}s  "
+              f"min {walls[0]:.4f}s  max {walls[-1]:.4f}s")
+    return 0
+
+
+def _pct(base: float, cand: float) -> Optional[float]:
+    if base == 0:
+        return None if cand == 0 else float("inf")
+    return (cand - base) / abs(base) * 100.0
+
+
+def diff(base_dir: str, cand_dir: str, threshold: float) -> int:
+    base = _session_end(_read_events(base_dir))
+    cand = _session_end(_read_events(cand_dir))
+    regressions: List[str] = []
+
+    def _section(name: str, b: Dict[str, Any], c: Dict[str, Any],
+                 gate: Tuple[str, ...], unit: str) -> None:
+        keys = sorted(set(b) | set(c))
+        if not keys:
+            return
+        print(f"{name}:")
+        for k in keys:
+            bv, cv = float(b.get(k, 0)), float(c.get(k, 0))
+            p = _pct(bv, cv)
+            ptxt = "   (new)" if p == float("inf") else (
+                "" if p is None else f" {p:+8.1f}%")
+            print(f"  {k:<32} {bv:>12g} -> {cv:>12g}{unit}{ptxt}")
+            gated = gate == ("*",) or k in gate
+            if gated and p is not None and p > threshold:
+                regressions.append(f"{k}: {bv:g} -> {cv:g} ({p:+.1f}%)")
+
+    _section("timer totals (s)", base.get("timer_totals", {}),
+             cand.get("timer_totals", {}), ("*",), "s")
+    _section("counters", base.get("counters", {}),
+             cand.get("counters", {}), REGRESSION_COUNTERS, "")
+    for scalar in ("compile_count", "hbm_high_water_bytes", "duration_s"):
+        bv, cv = float(base.get(scalar, 0)), float(cand.get(scalar, 0))
+        if bv or cv:
+            p = _pct(bv, cv)
+            ptxt = "" if p is None else (
+                " (new)" if p == float("inf") else f" ({p:+.1f}%)")
+            print(f"{scalar}: {bv:g} -> {cv:g}{ptxt}")
+            if scalar != "duration_s" and p is not None and p > threshold:
+                regressions.append(f"{scalar}: {bv:g} -> {cv:g}")
+    if regressions:
+        print(f"\nREGRESSIONS past {threshold:g}% threshold:",
+              file=sys.stderr)
+        for r in regressions:
+            print(f"  {r}", file=sys.stderr)
+        return 1
+    print(f"\nno regressions past {threshold:g}% threshold")
+    return 0
+
+
+def self_check(run_dir: str) -> int:
+    """Artifact validity: parseable JSONL with the required event types,
+    trace.json with monotonic timestamps and matched B/E span pairs."""
+    problems: List[str] = []
+    events = _read_events(run_dir)  # exits on parse failure
+    types = {e.get("ev") for e in events}
+    for required in ("session_start", "session_end"):
+        if required not in types:
+            problems.append(f"events.jsonl: missing {required} event")
+    for e in events:
+        if not isinstance(e.get("t"), (int, float)):
+            problems.append(f"events.jsonl: event without numeric t: {e}")
+            break
+    trace_path = os.path.join(run_dir, TRACE_FILE)
+    if not os.path.isfile(trace_path):
+        problems.append(f"missing {TRACE_FILE}")
+    else:
+        try:
+            with open(trace_path, "r", encoding="utf-8") as fh:
+                trace = json.load(fh)
+        except json.JSONDecodeError as e:
+            problems.append(f"{TRACE_FILE}: invalid JSON ({e})")
+            trace = None
+        if trace is not None:
+            tev = trace.get("traceEvents")
+            if not isinstance(tev, list):
+                problems.append(f"{TRACE_FILE}: no traceEvents list")
+                tev = []
+            last_ts = -1
+            depth: Dict[Tuple[int, int], int] = {}
+            for ev in tev:
+                ph = ev.get("ph")
+                if ph == "M":
+                    continue
+                ts = ev.get("ts")
+                if not isinstance(ts, int) or ts < 0:
+                    problems.append(f"{TRACE_FILE}: bad ts in {ev}")
+                    break
+                if ts < last_ts:
+                    problems.append(
+                        f"{TRACE_FILE}: ts not monotonic at {ev}")
+                    break
+                last_ts = ts
+                key = (ev.get("pid", 0), ev.get("tid", 0))
+                if ph == "B":
+                    depth[key] = depth.get(key, 0) + 1
+                elif ph == "E":
+                    depth[key] = depth.get(key, 0) - 1
+                    if depth[key] < 0:
+                        problems.append(
+                            f"{TRACE_FILE}: E without B on track {key}")
+                        break
+            for key, d in depth.items():
+                if d != 0:
+                    problems.append(
+                        f"{TRACE_FILE}: {d} unmatched B event(s) on "
+                        f"track {key}")
+    if problems:
+        for p in problems:
+            print(f"self-check FAIL: {p}", file=sys.stderr)
+        return 1
+    print(f"self-check OK: {run_dir} ({len(events)} events)")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="teldiff", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--self-check", metavar="RUN_DIR",
+                    help="validate a run's artifacts and exit")
+    sub = ap.add_subparsers(dest="cmd")
+    p_sum = sub.add_parser("summarize", help="print one run's summary")
+    p_sum.add_argument("run_dir")
+    p_diff = sub.add_parser("diff", help="compare two runs")
+    p_diff.add_argument("base_dir")
+    p_diff.add_argument("cand_dir")
+    p_diff.add_argument("--threshold", type=float, default=10.0,
+                        help="regression threshold in percent (default 10)")
+    args = ap.parse_args(argv)
+    if args.self_check:
+        return self_check(args.self_check)
+    if args.cmd == "summarize":
+        return summarize(args.run_dir)
+    if args.cmd == "diff":
+        return diff(args.base_dir, args.cand_dir, args.threshold)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
