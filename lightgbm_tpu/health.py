@@ -77,10 +77,10 @@ class HealthMonitor:
         n = int(tree.num_leaves)
         if n <= 0:
             return
-        ok = bool(np.isfinite(np.asarray(tree.leaf_value[:n])).all())
-        if ok and n > 1:
-            ok = bool(np.isfinite(np.asarray(tree.split_gain[:n - 1])).all())
-        if not ok:
+        finite = np.isfinite(tree.leaf_value[:n]).all()
+        if finite and n > 1:
+            finite = np.isfinite(tree.split_gain[:n - 1]).all()
+        if not finite:
             self._host_ok = False
 
     # -------------------------------------------------------------- admit
@@ -92,12 +92,13 @@ class HealthMonitor:
         self.observe(grads, hesses, gbdt.score)
         self._since_sync += 1
         if self._since_sync >= self.check_every:
+            # graftlint: disable=R1 -- the ONE deliberate scalar sync per check_every window; the accumulated logical_and collapses to a single bool pull, amortized per docs/ROBUSTNESS.md
             healthy = ((self._acc is None or bool(self._acc))
                        and self._host_ok)
             self._acc = None
             self._host_ok = True
             self._since_sync = 0
-            telemetry.emit("health_check", healthy=bool(healthy),
+            telemetry.emit("health_check", healthy=healthy,
                            policy=self.policy, iteration=int(gbdt.iter_))
             if not healthy:
                 grads, hesses = self._handle(gbdt, grads, hesses)

@@ -29,7 +29,7 @@ from ..ops.predict import (PredictorCache, pack_ensemble, predict_dtype,
                            stream_chunk_rows)
 from ..ops.score import add_tree_to_score
 from ..treelearner import create_tree_learner
-from ..utils import faults
+from ..utils import faults, sanitize
 from ..utils.log import Log
 from ..utils.timer import global_timer
 from .sample_strategy import create_sample_strategy
@@ -402,8 +402,11 @@ class GBDT:
             gh_ext = _pack_gh(grads, hesses)
         with global_timer.scope("tree_train"):
             pending = self.tree_learner.train_async(gh_ext, None)
+        apply_log = sanitize.guard(
+            _apply_split_log_to_score, (0,),
+            "_apply_split_log_to_score (models/gbdt.py async score update)")
         with global_timer.scope("update_score"):
-            self.score = self.score.at[0].set(_apply_split_log_to_score(
+            self.score = self.score.at[0].set(apply_log(
                 self.score[0], _colocate(pending.rec_store, self.score),
                 _colocate(pending.leaf_id, self.score),
                 jnp.float32(self.shrinkage_rate), self.config.num_leaves))

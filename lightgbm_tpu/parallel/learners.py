@@ -47,6 +47,7 @@ from ..treelearner.device import (REC, DeviceTreeLearner, _PendingTree,
                                   make_sharded_grow_fn)
 from ..treelearner.serial import (SerialTreeLearner, _LeafState,
                                   device_growth_applies)
+from ..utils import sanitize
 from ..utils.compat import shard_map
 from ..utils.log import Log
 from ..utils.timer import global_timer
@@ -540,6 +541,7 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         gh = gh_ext[:-1]
         if bag_indices is not None:
             in_bag = np.zeros(n, dtype=bool)
+            # graftlint: disable=R1 -- bag_indices is a host ndarray from the bagging sampler (see the parameter annotation); asarray only normalizes dtype, nothing crosses the device boundary
             in_bag[np.asarray(bag_indices, dtype=np.int64)] = True
             gh = jnp.where(jnp.asarray(in_bag, dtype=jnp.bool_)[:, None], gh,
                            jnp.zeros((), gh.dtype))
@@ -568,9 +570,11 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
             n_bag, cfg.num_grad_quant_bins)
         self._record_carry_bytes()
         self._record_ici_bytes(narrow)
+        grow = sanitize.guard(
+            self._grow_fn(bag_indices is not None, narrow), (0, 1, 2),
+            "the sharded grow dispatch (parallel/learners.py train_async)")
         with global_timer.scope("tree_device"):
-            rec_store, leaf_id, _, hist_rows, n_waves = self._grow_fn(
-                bag_indices is not None, narrow)(
+            rec_store, leaf_id, _, hist_rows, n_waves = grow(
                 jnp.copy(self.bins_dev), gh_sh, leaf_sh, self._gidx_rep,
                 self._vslot_rep, self.scan_meta_sharded, self._tables_rep,
                 self._params_rep, fmask_sh, scale_rep)

@@ -58,6 +58,7 @@ from ..ops.histogram import build_histogram
 from ..ops.split import (SPLIT_FIELDS, ScanMeta, SplitInfo, find_best_split,
                          fix_feature_hist, gather_feature_hist_raw,
                          per_feature_best, reduce_best_record)
+from ..utils import sanitize
 from ..utils.compat import shard_map
 from ..utils.log import Log
 from ..utils.timer import global_timer
@@ -636,6 +637,7 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
 # XLA reuses their allocations for the loop carries instead of double
 # buffering the two largest arrays. CPU backends ignore donation (warning
 # suppressed by Python's default dedup filter).
+# graftlint: disable=R11 -- this entry traces _grow_impl with the STATIC arg sharded=False, so every `if sharded:` collective is pruned from this trace; the sharded trace exists only inside make_sharded_grow_fn's shard_map, and test_sharded_device.py locks both paths bit-identical
 @partial(jax.jit,
          static_argnames=("num_leaves", "num_bins", "max_depth", "quantized",
                           "batch", "bagged"),
@@ -875,10 +877,13 @@ class DeviceTreeLearner(SerialTreeLearner):
         else:
             fmask = jnp.ones(len(self.meta.real_feature), dtype=bool)
         self._record_carry_bytes()
+        grow = sanitize.guard(
+            grow_tree_on_device, (0, 1, 2),
+            "grow_tree_on_device (treelearner/device.py train_async)")
         with global_timer.scope("tree_device"):
             # bins_dev is COPIED per tree: grow_tree_on_device donates its
             # first three args (gh and leaf_id0 are already fresh buffers)
-            rec_store, leaf_id, _, hist_rows, n_waves = grow_tree_on_device(
+            rec_store, leaf_id, _, hist_rows, n_waves = grow(
                 jnp.copy(self.bins_dev), gh, leaf_id0, self.meta,
                 self.tables, self.params_dev, fmask, num_leaves,
                 self.group_bin_padded,

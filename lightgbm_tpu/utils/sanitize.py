@@ -1,0 +1,190 @@
+"""Runtime donation/sync sanitizer (LGBM_TPU_SANITIZE=1).
+
+The dynamic counterpart of graftlint's static R1/R10 passes: where the
+linter proves properties over the call graph, the sanitizer enforces them
+on a real run —
+
+* **Use-after-donation poisoning.** `guard(fn, donate, site)` wraps a
+  dispatch whose jit donates buffer arguments. After the call, every
+  donated `jax.Array` positional arg is deleted and registered; any later
+  host access to that Python reference raises `UseAfterDonationError`
+  naming the donation site, instead of silently reading a recycled buffer
+  on TPU (on CPU, where XLA ignores donation, the bug would otherwise pass
+  tests and only corrupt results on the accelerator).
+
+* **Sync accounting.** Host-sync entry points on `jax.Array`
+  (`item`/`tolist`/`block_until_ready`/`__bool__`/`__float__`/`__int__`)
+  are counted per innermost `global_timer.scope` label (the timer keeps
+  its label stack even with LGBM_TPU_TIMETAG off). Scopes listed in
+  `SYNC_FREE` assert zero syncs: any counted sync while such a scope is
+  open raises `SyncInScopeError` naming the scope and the sync kind.
+
+Known gap: `np.asarray(arr)` reaches the host through the buffer protocol
+without calling any patchable `jax.Array` method (patching `__array__` on
+ArrayImpl does not intercept it), so asarray pulls are invisible to the
+sync counter. They ARE covered by the poison pass — asarray on a deleted
+array still goes through `_check_if_deleted` — and by graftlint R1
+statically.
+
+Everything here is inert unless enabled: `guard` returns its argument
+unchanged and no class is patched, so the production path pays one
+function call and an env lookup per tree dispatch.
+"""
+from __future__ import annotations
+
+import os
+from collections import defaultdict
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+from .timer import global_timer
+
+
+class UseAfterDonationError(RuntimeError):
+    """A host access hit a buffer that was donated to an earlier dispatch."""
+
+
+class SyncInScopeError(RuntimeError):
+    """A device sync happened inside a scope declared sync-free."""
+
+
+# scopes asserted to perform ZERO countable device syncs while open
+SYNC_FREE = {"tree_device"}
+
+_forced: Optional[bool] = None
+_installed = False
+_orig: Dict[str, Callable] = {}
+# id(arr) -> (arr, site): strong refs keep id() stable for the run
+_poisoned: Dict[int, Tuple[Any, str]] = {}
+_sync_counts: Dict[str, Dict[str, int]] = defaultdict(
+    lambda: defaultdict(int))
+
+
+def enabled() -> bool:
+    if _forced is not None:
+        return _forced
+    return os.environ.get("LGBM_TPU_SANITIZE", "") not in ("", "0")
+
+
+def enable() -> None:
+    """Force-on regardless of the env var; installs the jax.Array patches."""
+    global _forced
+    _forced = True
+    _install()
+
+
+def disable() -> None:
+    """Force-off regardless of the env var; patches stay installed but
+    become pass-throughs (they consult `enabled()` per call)."""
+    global _forced
+    _forced = False
+
+
+def clear_override() -> None:
+    """Back to env-var-driven (undoes enable()/disable())."""
+    global _forced
+    _forced = None
+
+
+def reset() -> None:
+    """Drop the poison registry and sync counters (between test cases)."""
+    _poisoned.clear()
+    _sync_counts.clear()
+
+
+def sync_counts() -> Dict[str, Dict[str, int]]:
+    """Per-scope-label sync counts: {label: {kind: n}}."""
+    return {label: dict(kinds) for label, kinds in _sync_counts.items()}
+
+
+def _note_sync(kind: str) -> None:
+    stack = global_timer.label_stack
+    label = stack[-1] if stack else "<no-scope>"
+    _sync_counts[label][kind] += 1
+    bad = SYNC_FREE.intersection(stack)
+    if bad:
+        scope = sorted(bad)[0]
+        raise SyncInScopeError(
+            f"device sync ({kind}) inside the sync-free scope {scope!r}: "
+            f"this region is asserted to stay on-device end to end — a "
+            f"sync here serializes the async pipeline (see "
+            f"docs/PERF_NOTES.md)")
+
+
+def _install() -> None:
+    """Patch jax.Array's concrete class once per process.
+
+    The poison check rides `_check_if_deleted`, which every host-facing
+    accessor (item, __array__, np.asarray, device_get, ...) calls first;
+    the sync counters wrap the explicit sync entry points.
+    """
+    global _installed
+    if _installed:
+        return
+    from jax._src.array import ArrayImpl
+
+    _orig["_check_if_deleted"] = ArrayImpl._check_if_deleted
+
+    def _checked(self):
+        ent = _poisoned.get(id(self))
+        if ent is not None:
+            raise UseAfterDonationError(
+                f"this array's buffer was donated to {ent[1]}; XLA reuses "
+                f"donated buffers in place, so reading the old reference "
+                f"returns garbage on TPU — copy before the dispatch or "
+                f"read the dispatch's output instead")
+        return _orig["_check_if_deleted"](self)
+
+    ArrayImpl._check_if_deleted = _checked
+
+    def _counted(name: str):
+        orig = _orig[name]
+
+        def wrapper(self, *args, **kwargs):
+            if enabled():
+                _note_sync(name)
+            return orig(self, *args, **kwargs)
+
+        wrapper.__name__ = name
+        return wrapper
+
+    for name in ("item", "tolist", "block_until_ready",
+                 "__bool__", "__float__", "__int__"):
+        _orig[name] = getattr(ArrayImpl, name)
+        setattr(ArrayImpl, name, _counted(name))
+    _installed = True
+
+
+def guard(fn: Callable, donate: Sequence[int], site: str) -> Callable:
+    """Wrap a donating dispatch so its donated args are poisoned after use.
+
+    `donate` lists the POSITIONAL indices the jit donates (its
+    donate_argnums); `site` names the dispatch for the eventual error.
+    Identity when the sanitizer is off. Args that reappear in the output
+    pytree (possible when XLA aliases through) are left alone.
+    """
+    if not enabled():
+        return fn
+    _install()
+    import jax
+
+    def wrapper(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        out_ids = {id(leaf) for leaf in jax.tree_util.tree_leaves(out)}
+        for i in donate:
+            if i >= len(args):
+                continue
+            arr = args[i]
+            if isinstance(arr, jax.Array) and id(arr) not in out_ids:
+                # when the jit really donated (TPU, or CPU backends that
+                # honor it) the buffer is ALREADY deleted — registering it
+                # upgrades jax's generic "Array has been deleted" into an
+                # error naming the donation site; on backends that ignore
+                # donation, delete() poisons it ourselves (async-safe: the
+                # runtime holds the buffer until in-flight consumers
+                # finish)
+                if not arr.is_deleted():
+                    arr.delete()
+                _poisoned[id(arr)] = (arr, site)
+        return out
+
+    return wrapper

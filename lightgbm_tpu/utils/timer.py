@@ -20,7 +20,7 @@ import functools
 import os
 import time
 from collections import defaultdict
-from typing import Callable, Dict, Iterator, Optional
+from typing import Callable, Dict, Iterator, List, Optional
 
 
 class GlobalTimer:
@@ -36,11 +36,20 @@ class GlobalTimer:
         # telemetry sink: called as span_hook(label, t0, t1) on every closed
         # scope (perf_counter seconds). None when no session is recording.
         self.span_hook: Optional[Callable[[str, float, float], None]] = None
+        # always-maintained stack of open scope labels (a list push/pop is
+        # nanoseconds): the sanitizer attributes counted device syncs to
+        # the innermost scope even when wall-clock timing is off, so
+        # sync-free assertions (utils/sanitize.py) work without TIMETAG.
+        self.label_stack: List[str] = []
 
     @contextlib.contextmanager
     def scope(self, label: str) -> Iterator[None]:
         if not self.enabled:
-            yield
+            self.label_stack.append(label)
+            try:
+                yield
+            finally:
+                self.label_stack.pop()
             return
         try:
             import jax.profiler
@@ -49,8 +58,12 @@ class GlobalTimer:
         except Exception:  # pragma: no cover - profiler unavailable
             ctx = contextlib.nullcontext()
         start = time.perf_counter()
-        with ctx:
-            yield
+        self.label_stack.append(label)
+        try:
+            with ctx:
+                yield
+        finally:
+            self.label_stack.pop()
         end = time.perf_counter()
         self.totals[label] += end - start
         self.counts[label] += 1

@@ -1,0 +1,15 @@
+"""The training loop: dispatches a jitted kernel per iteration and calls
+a telemetry hook from inside the same loop — the hook's host pull is the
+hot-dispatch-path shape R1v2's pass B exists for.
+"""
+from .. import telemetry
+from ..ops import kernels
+
+
+def train(xs, delta):
+    out = []
+    for x in xs:
+        y = kernels.consume(x, delta)
+        telemetry.emit_row(y)  # hook called on the dispatch path
+        out.append(y)
+    return out

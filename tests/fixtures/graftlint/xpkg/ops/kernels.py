@@ -1,0 +1,29 @@
+"""Jitted entry points. The module-level import of treelearner.stats —
+which itself imports this module back for SCALE — is a deliberate import
+cycle: the call graph must terminate and still resolve both directions.
+"""
+from functools import partial
+
+import jax
+
+from ..treelearner import stats
+
+SCALE = 3.0
+
+
+@jax.jit
+def scale(x):
+    # jit seed: the sync hides one module away, inside stats.normalize
+    return stats.normalize(x)
+
+
+@jax.jit
+def centered(x):
+    # reaches stats.center, whose sync wears a reasoned suppression
+    return stats.center(x)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def consume(buf, delta):
+    # partial-wrapped jit decorator: unwrapping must surface the donation
+    return buf + delta

@@ -1,0 +1,12 @@
+"""The mesh wrapper: binds axis 'data' around treelearner.steps.grow_step
+from a DIFFERENT module than the collective that consumes it.
+"""
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..treelearner import steps
+
+
+def make_sharded_step(mesh):
+    return shard_map(steps.grow_step, mesh=mesh,
+                     in_specs=(P("data"),), out_specs=P("data"))

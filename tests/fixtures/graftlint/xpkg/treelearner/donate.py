@@ -1,0 +1,88 @@
+"""R10 use-after-donation shapes: every way a donation can reach a call
+site (decorator, jit alias, partial shift, method dispatch, interprocedural
+summary, pallas literal aliases) with a read-after for each, plus the
+compliant idioms that must stay clean.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..ops.kernels import consume
+
+
+def direct_bad(buf, delta):
+    out = consume(buf, delta)
+    total = buf.sum()  # line 17: buf's buffer was donated at line 16
+    return out, total
+
+
+def direct_ok(buf, delta):
+    out = consume(jnp.copy(buf), delta)  # fresh temp donated: compliant
+    return out, buf.sum()
+
+
+def rebound_ok(buf, delta):
+    buf = consume(buf, delta)  # rebound to the output: the donated
+    return buf.sum()           # reference is dead, the read is the result
+
+
+def loop_bad(bufs, delta):
+    acc = 0.0
+    for b in bufs:
+        out = consume(b, delta)
+        acc = acc + b.sum()  # line 34: same-iteration read after donation
+    return acc, out
+
+
+def suppressed_read(buf, delta):
+    out = consume(buf, delta)
+    # graftlint: disable=R10 -- fixture: pretend a checkpoint pinned a host copy of buf before the dispatch
+    return out, buf.sum()
+
+
+def _impl(a, b):
+    return a * b
+
+
+scaled = jax.jit(_impl, donate_argnums=(1,))
+
+
+def alias_bad(a, b):
+    r = scaled(a, b)
+    return r + b  # line 53: 'b' donated through the jit ALIAS
+
+
+@partial(jax.jit, donate_argnums=(1,))
+def axpy(alpha, x):
+    return alpha * x
+
+
+saxpy = partial(axpy, 2.0)  # shifts donate_argnums=(1,) to position 0
+
+
+def partial_bad(x):
+    y = saxpy(x)
+    return y + x  # line 66: 'x' donated through the partial shift
+
+
+class Learner:
+    def _dispatch(self, buf, delta):
+        # forwards its own param into consume's donated slot: the summary
+        # fixpoint must mark _dispatch as donating positional 0
+        return consume(buf, delta)
+
+    def run_bad(self, buf, delta):
+        out = self._dispatch(buf, delta)
+        return out, buf.sum()  # line 77: donated via the method summary
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def pallas_bad(x):
+    out = pl.pallas_call(
+        _kernel, out_shape=x, input_output_aliases={0: 0})(x)
+    return out + x  # line 87: aliased in-place by the pallas kernel

@@ -1,0 +1,21 @@
+"""Host-sync helpers. No jit in THIS module, so the module-local R1 pass
+sees nothing jit-reachable here — only the cross-module pass (R1v2) can
+prove ops.kernels traces these bodies.
+"""
+from ..ops import kernels  # import cycle back into ops.kernels
+
+
+def normalize(x):
+    lo = x.min().item()  # line 9: flagged by R1v2 (reachable via kernels)
+    return (x - lo) * kernels.SCALE
+
+
+def center(x):
+    # graftlint: disable=R1 -- fixture: pretend the calibration contract requires a host round-trip here
+    mid = x.mean().item()
+    return x - mid
+
+
+def offline_summary(x):
+    # NOT jit-reachable from anywhere: both passes must stay quiet
+    return x.min().item(), x.max().item()

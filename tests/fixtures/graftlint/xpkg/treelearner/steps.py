@@ -1,0 +1,26 @@
+"""R11 collective-context shapes: one entry whose every collective is
+bound by parallel/shard.py's cross-module shard_map, one jitted entry that
+reaches the same collective with NO binding on its path (flagged), and a
+suppressed twin.
+"""
+import jax
+
+
+def grow_step(x):
+    return reduce_hist(x)
+
+
+def reduce_hist(x):
+    # graftlint: disable=R7 -- the 'data' axis is bound by parallel/shard.py's shard_map; the module-local pass cannot see across the import — R11 proves the path
+    return jax.lax.psum(x, "data")
+
+
+@jax.jit
+def unbound_entry(x):  # line 18: R11 — no shard_map binds 'data' here
+    return grow_step(x)
+
+
+# graftlint: disable=R11 -- fixture: pretend a static flag prunes the collective from this trace
+@jax.jit
+def pruned_entry(x):
+    return grow_step(x)

@@ -284,12 +284,30 @@ def test_ignore_filters_rules():
 def test_rule_codes_cover_names_and_codes():
     table = rule_codes()
     for ident in ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8", "R9",
-                  "jit-donation", "jit-host-sync",
+                  "R10", "R11",
+                  "jit-donation", "jit-host-sync", "jit-host-sync-xmod",
                   "implicit-dtype", "pallas-tile-shape",
                   "pallas-prefetch-arity", "pallas-host-op",
                   "param-unread", "untimed-hot-func", "collective-axis",
-                  "non-atomic-write", "telemetry-hygiene"):
+                  "non-atomic-write", "telemetry-hygiene",
+                  "use-after-donation", "collective-context"):
         assert ident in table
+    # two rules share the R1 code; the code must keep resolving to the
+    # ORIGINAL local rule, with the family expansion covering both
+    assert table["R1"] == "jit-host-sync"
+
+
+def test_code_family_expansion_covers_both_r1_rules():
+    from tools.graftlint.rules import code_families
+
+    fams = code_families()
+    assert {"jit-host-sync", "jit-host-sync-xmod"} <= set(fams["R1"])
+    # selecting by code runs the whole family; ignoring by code drops it
+    both = run_lint(FIXTURES, select=["R1"])
+    assert any(v.rule == "jit-host-sync" for v in both.violations)
+    none = run_lint(FIXTURES, ignore=["R1"])
+    assert not any(v.rule.startswith("jit-host-sync")
+                   for v in none.violations)
 
 
 # -- the gate: the real package is clean ----------------------------------

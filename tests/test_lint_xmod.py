@@ -1,0 +1,266 @@
+"""graftlint v2 whole-program analysis: the interprocedural fixture
+package (tests/fixtures/graftlint/xpkg) exercises the call graph —
+import cycles, partial-wrapped jit, method dispatch — and the three
+cross-module rules; plus the incremental cache and SARIF export.
+
+The headline property fixtures assert: every v2 finding is INVISIBLE to
+the module-local v1 pass (run with select=jit-host-sync the package is
+clean) and caught by the whole-program pass at an exact line.
+"""
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import run_lint
+from tools.graftlint.cache import CacheStore
+from tools.graftlint.callgraph import get_callgraph, import_deps
+from tools.graftlint.core import collect
+from tools.graftlint.sarif import to_sarif
+
+REPO = Path(__file__).resolve().parent.parent
+XPKG = REPO / "tests" / "fixtures" / "graftlint" / "xpkg"
+
+
+@pytest.fixture(scope="module")
+def result():
+    return run_lint(XPKG)
+
+
+def _hits(result, rule, path=None, suppressed=False):
+    pool = result.suppressed if suppressed else result.violations
+    return [v for v in pool
+            if v.rule == rule and (path is None or v.path == path)]
+
+
+# -- R1v2 cross-module sync escape ---------------------------------------
+
+def test_v1_alone_is_blind_to_every_xpkg_finding():
+    # the entire fixture package is CLEAN under the module-local pass:
+    # every defect needs the call graph to see
+    v1 = run_lint(XPKG, select=["jit-host-sync"])
+    assert v1.violations == []
+
+
+def test_xmod_sync_through_import_cycle(result):
+    bad = _hits(result, "jit-host-sync-xmod", "treelearner/stats.py")
+    assert [v.line for v in bad] == [9]
+    assert "jit-reachable via ops/kernels.py:17" in bad[0].message
+
+
+def test_xmod_suppression_honored(result):
+    sup = _hits(result, "jit-host-sync-xmod", "treelearner/stats.py",
+                suppressed=True)
+    assert [v.line for v in sup] == [15]
+    assert "calibration contract" in sup[0].reason
+
+
+def test_unreachable_helper_stays_quiet(result):
+    # offline_summary's syncs are not jit-reachable from anywhere
+    lines = {v.line for v in _hits(result, "jit-host-sync-xmod",
+                                   "treelearner/stats.py")}
+    assert 21 not in lines
+
+
+def test_hot_dispatch_hook_flagged(result):
+    bad = _hits(result, "jit-host-sync-xmod", "telemetry.py")
+    assert [v.line for v in bad] == [8]
+    assert "hot dispatch path" in bad[0].message
+    assert "models/driver.py:11" in bad[0].message  # the loop that reaches it
+
+
+# -- R10 use-after-donation ----------------------------------------------
+
+def test_r10_flags_every_donation_shape(result):
+    lines = {v.line for v in _hits(result, "use-after-donation",
+                                   "treelearner/donate.py")}
+    # direct, loop-carried, jit alias, partial shift, method summary,
+    # pallas literal input_output_aliases
+    assert lines == {17, 35, 54, 67, 78, 88}
+
+
+def test_r10_compliant_idioms_clean(result):
+    lines = {v.line for v in _hits(result, "use-after-donation",
+                                   "treelearner/donate.py")}
+    # direct_ok (fresh jnp.copy donated) and rebound_ok (donate-and-
+    # replace: `buf = consume(buf, ...)`) must not fire
+    assert not lines & set(range(21, 29))
+
+
+def test_r10_suppression_honored(result):
+    sup = _hits(result, "use-after-donation", "treelearner/donate.py",
+                suppressed=True)
+    assert [v.line for v in sup] == [42]
+    assert "pinned a host copy" in sup[0].reason
+
+
+# -- R11 collective-context ----------------------------------------------
+
+def test_r11_unbound_jit_entry_flagged(result):
+    bad = _hits(result, "collective-context", "treelearner/steps.py")
+    assert [v.line for v in bad] == [18]
+    assert "axis 'data'" in bad[0].message
+    assert "treelearner/steps.py:15" in bad[0].message  # witness collective
+
+
+def test_r11_cross_module_shard_map_binds(result):
+    # grow_step itself is never flagged: parallel/shard.py's wrap binds
+    # 'data' on that path, and the R7 suppression carries the rationale
+    assert len(_hits(result, "collective-context")) == 1
+    sup = _hits(result, "collective-axis", "treelearner/steps.py",
+                suppressed=True)
+    assert [v.line for v in sup] == [15]
+
+
+def test_r11_suppression_honored(result):
+    sup = _hits(result, "collective-context", "treelearner/steps.py",
+                suppressed=True)
+    assert [v.line for v in sup] == [24]
+
+
+# -- the call graph itself -----------------------------------------------
+
+def test_import_cycle_resolves_both_directions():
+    pkg = collect(XPKG)
+    deps = import_deps(pkg)
+    assert "treelearner/stats.py" in deps["ops/kernels.py"]
+    assert "ops/kernels.py" in deps["treelearner/stats.py"]
+
+
+def test_partial_wrapped_jit_donation_survives_unwrap():
+    pkg = collect(XPKG)
+    g = get_callgraph(pkg)
+    # decorator form: @partial(jax.jit, donate_argnums=(0,))
+    consume = g.nodes["ops.kernels:consume"]
+    assert consume.jitted and consume.donate == (0,)
+    # alias form shifted through functools.partial: the call edge from
+    # partial_bad carries offset 1 into axpy's donate_argnums=(1,)
+    edges = [e for e in g.nodes["treelearner.donate:partial_bad"].edges
+             if e.target == "treelearner.donate:axpy"]
+    assert edges and edges[0].offset == 1
+
+
+def test_method_dispatch_resolved():
+    pkg = collect(XPKG)
+    g = get_callgraph(pkg)
+    edges = g.nodes["treelearner.donate:Learner.run_bad"].edges
+    assert any(e.target == "treelearner.donate:Learner._dispatch"
+               for e in edges)
+
+
+# -- incremental cache ----------------------------------------------------
+
+def _copy_xpkg(tmp_path):
+    root = tmp_path / "xpkg"
+    shutil.copytree(XPKG, root)
+    return root
+
+
+def test_cache_full_hit_reproduces_results(tmp_path):
+    root = _copy_xpkg(tmp_path)
+    cache_dir = tmp_path / "cache"
+    cold = run_lint(root, cache=CacheStore(root, cache_dir=cache_dir))
+    warm = run_lint(root, cache=CacheStore(root, cache_dir=cache_dir))
+    assert [v.render() for v in warm.violations] == \
+           [v.render() for v in cold.violations]
+    assert [v.render() for v in warm.suppressed] == \
+           [v.render() for v in cold.suppressed]
+    # an unchanged tree is a full hit: nothing invalid, whole-program
+    # findings served from cache
+    cached, invalid, wp = CacheStore(root, cache_dir=cache_dir).plan(
+        collect(root))
+    assert not invalid
+    assert wp is not None
+
+
+def test_cache_cross_file_invalidation(tmp_path):
+    """Editing ops/kernels.py must invalidate treelearner/stats.py's
+    entry (stats imports kernels) AND rerun the whole-program pass: the
+    stats.py finding exists only because kernels jits the call path."""
+    root = _copy_xpkg(tmp_path)
+    cache_dir = tmp_path / "cache"
+    cold = run_lint(root, cache=CacheStore(root, cache_dir=cache_dir))
+    assert any(v.path == "treelearner/stats.py" and v.line == 9
+               for v in cold.violations)
+    kernels = root / "ops" / "kernels.py"
+    kernels.write_text(kernels.read_text().replace(
+        "@jax.jit\ndef scale", "def scale"))
+    cached, invalid, wp = CacheStore(root, cache_dir=cache_dir).plan(
+        collect(root))
+    assert wp is None  # a changed tree can't serve whole-program findings
+    assert "ops/kernels.py" in invalid
+    assert "treelearner/stats.py" in invalid  # reverse dependency
+    assert "parallel/shard.py" not in invalid  # doesn't import kernels
+    after = run_lint(root, cache=CacheStore(root, cache_dir=cache_dir))
+    # scale() is no longer a jit seed, so normalize's sync is unreachable
+    assert not any(v.path == "treelearner/stats.py" and v.line == 9
+                   for v in after.violations)
+
+
+def test_cache_invalidated_by_rules_digest(tmp_path, monkeypatch):
+    root = _copy_xpkg(tmp_path)
+    cache_dir = tmp_path / "cache"
+    run_lint(root, cache=CacheStore(root, cache_dir=cache_dir))
+    store = CacheStore(root, cache_dir=cache_dir)
+    monkeypatch.setattr(store, "_rules_digest", "different")
+    cached, invalid, wp = store.plan(collect(root))
+    assert wp is None and len(invalid) == len(collect(root).files)
+
+
+# -- SARIF ---------------------------------------------------------------
+
+def test_sarif_document_shape(result):
+    doc = to_sarif(result.violations, result.suppressed)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"jit-host-sync-xmod", "use-after-donation",
+            "collective-context", "jit-host-sync"} <= ids
+    results = run["results"]
+    assert len(results) == len(result.violations) + len(result.suppressed)
+    sup = [r for r in results if r.get("suppressions")]
+    assert len(sup) == len(result.suppressed)
+    for s in sup:
+        assert s["suppressions"][0]["kind"] == "inSource"
+        assert s["suppressions"][0]["justification"]
+        assert s["level"] == "note"
+
+
+def test_sarif_columns_are_one_based(result):
+    v = next(v for v in result.violations
+             if v.path == "treelearner/stats.py" and v.line == 9)
+    doc = to_sarif([v])
+    region = doc["runs"][0]["results"][0]["locations"][0][
+        "physicalLocation"]["region"]
+    assert region["startLine"] == 9
+    assert region["startColumn"] == v.col + 1
+
+
+def test_cli_sarif_output():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint",
+         "tests/fixtures/graftlint/xpkg", "--format", "sarif",
+         "--no-cache"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 1  # fixtures have violations by design
+    doc = json.loads(proc.stdout)
+    uris = {r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"]
+            for r in doc["runs"][0]["results"]}
+    # re-rooted at the linted directory so paths resolve from the repo root
+    assert "tests/fixtures/graftlint/xpkg/treelearner/stats.py" in uris
+
+
+def test_cli_caches_by_default(tmp_path):
+    env = {"PYTHONPATH": str(REPO), "PATH": "/usr/bin:/bin:/usr/local/bin"}
+    cmd = [sys.executable, "-m", "tools.graftlint", str(XPKG)]
+    first = subprocess.run(cmd, cwd=tmp_path, capture_output=True,
+                           text=True, env=env)
+    assert first.returncode == 1
+    cache_files = list((tmp_path / ".graftlint_cache").glob("*.json"))
+    assert len(cache_files) == 1
+    second = subprocess.run(cmd, cwd=tmp_path, capture_output=True,
+                            text=True, env=env)
+    assert second.stdout == first.stdout

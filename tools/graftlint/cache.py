@@ -1,0 +1,164 @@
+"""Content-hash incremental cache for graftlint.
+
+A lint of the full package parses every file regardless (the whole-program
+rules need the complete call graph), so what the cache actually saves is
+RULE EXECUTION:
+
+* file-local rules rerun only on files whose content digest — or the
+  digest of anything in their transitive in-package import closure —
+  changed since the last run (the call graph's `import_deps` is what makes
+  this cross-file-aware: touching `treelearner/device.py` invalidates
+  `parallel/learners.py`, which imports it);
+* whole-program rules (call-graph passes) rerun whenever ANY file changed,
+  and are served from cache only on a fully-unchanged tree.
+
+Every entry is keyed on a digest of the linter's own source tree
+(`rules_digest`) plus the canonicalized select/ignore filters, so editing
+a rule or changing the rule set invalidates everything — stale findings
+can never outlive the code that produced them.
+
+Cache location: `.graftlint_cache/<sha16-of-root>.json` under the working
+directory (one file per linted root). Writes are atomic (tmp + rename);
+a corrupt or unreadable cache degrades to a full run, never to an error.
+The library-level `run_lint` does NOT cache by default; the CLI opts in
+(disable with `--no-cache`).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from dataclasses import asdict
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Package, Violation
+
+_VERSION = 1
+
+
+def _sha(data: str) -> str:
+    return hashlib.sha256(data.encode("utf-8", "surrogateescape")).hexdigest()
+
+
+def file_digest(source: str) -> str:
+    return _sha(source)[:32]
+
+
+def rules_digest() -> str:
+    """Digest of the graftlint source tree itself: any edit to a rule, the
+    call graph, or this module invalidates every cache entry."""
+    tree = Path(__file__).parent
+    h = hashlib.sha256()
+    for path in sorted(tree.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        h.update(path.relative_to(tree).as_posix().encode())
+        h.update(b"\0")
+        h.update(path.read_bytes())
+        h.update(b"\0")
+    return h.hexdigest()[:32]
+
+
+def _canon_filters(select: Optional[Sequence[str]],
+                   ignore: Optional[Sequence[str]]) -> List[List[str]]:
+    return [sorted(select) if select else [],
+            sorted(ignore) if ignore else []]
+
+
+class CacheStore:
+    """One linted root's cache file, plus the plan/save protocol run_lint
+    drives: `plan()` splits the package into served-from-cache and must-
+    rerun sets, `save()` records this run's raw (pre-suppression) findings
+    for the next one."""
+
+    def __init__(self, root: Path, cache_dir: Optional[Path] = None) -> None:
+        self.root = Path(root)
+        base = Path(cache_dir) if cache_dir is not None \
+            else Path.cwd() / ".graftlint_cache"
+        key = _sha(str(self.root.resolve()))[:16]
+        self.path = base / ("%s.json" % key)
+        self._rules_digest = rules_digest()
+
+    # -- load / validate ---------------------------------------------------
+    def _load(self, filters: List[List[str]]) -> Optional[dict]:
+        try:
+            data = json.loads(self.path.read_text())
+        except (OSError, ValueError):
+            return None
+        if not isinstance(data, dict) or data.get("version") != _VERSION:
+            return None
+        if data.get("rules_digest") != self._rules_digest:
+            return None
+        if data.get("filters") != filters:
+            return None
+        return data
+
+    def plan(self, pkg: Package,
+             select: Optional[Sequence[str]] = None,
+             ignore: Optional[Sequence[str]] = None,
+             ) -> Tuple[Dict[str, List[Violation]], Set[str],
+                        Optional[List[Violation]]]:
+        """Returns (cached_local_findings_by_relpath, invalid_relpaths,
+        cached_whole_program_findings_or_None)."""
+        digests = {ctx.relpath: file_digest(ctx.source) for ctx in pkg.files}
+        data = self._load(_canon_filters(select, ignore))
+        if data is None:
+            return {}, set(digests), None
+        entries = data.get("files", {})
+        cached: Dict[str, List[Violation]] = {}
+        invalid: Set[str] = set()
+        for rel, digest in digests.items():
+            ent = entries.get(rel)
+            ok = (isinstance(ent, dict) and ent.get("digest") == digest
+                  and all(digests.get(dep) == dep_digest
+                          for dep, dep_digest in ent.get("deps", {}).items()))
+            if not ok:
+                invalid.add(rel)
+                continue
+            cached[rel] = [Violation(**f) for f in ent.get("findings", [])]
+        # whole-program findings survive only a fully-unchanged tree: same
+        # relpath set, every digest equal
+        wp: Optional[List[Violation]] = None
+        if not invalid and set(entries) == set(digests):
+            wp_raw = data.get("whole_program")
+            if isinstance(wp_raw, list):
+                wp = [Violation(**f) for f in wp_raw]
+        return cached, invalid, wp
+
+    # -- save --------------------------------------------------------------
+    def save(self, pkg: Package,
+             local_by_file: Dict[str, List[Violation]],
+             whole_program: List[Violation],
+             select: Optional[Sequence[str]] = None,
+             ignore: Optional[Sequence[str]] = None) -> None:
+        from .callgraph import import_deps
+
+        digests = {ctx.relpath: file_digest(ctx.source) for ctx in pkg.files}
+        deps = import_deps(pkg)
+        data = {
+            "version": _VERSION,
+            "rules_digest": self._rules_digest,
+            "filters": _canon_filters(select, ignore),
+            "files": {
+                rel: {
+                    "digest": digests[rel],
+                    "deps": {d: digests[d] for d in sorted(deps.get(rel, ()))
+                             if d in digests},
+                    "findings": [asdict(v)
+                                 for v in local_by_file.get(rel, [])],
+                }
+                for rel in digests
+            },
+            "whole_program": [asdict(v) for v in whole_program],
+        }
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=str(self.path.parent),
+                                       suffix=".tmp")
+            with os.fdopen(fd, "w") as f:
+                json.dump(data, f, separators=(",", ":"))
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # a cache that can't be written is just a slow lint

@@ -1,0 +1,935 @@
+"""Whole-program symbol table + call graph for the interprocedural passes.
+
+The v1 rules are module-local by design (R1's docstring used to say
+"cross-module reachability is intentionally out of scope"). PRs 3-7 made
+exactly the code shape that scoping cannot protect: donated buffers and
+collectives flowing through treelearner/device.py, parallel/learners.py
+and models/gbdt.py, with telemetry/health hooks called from the engine
+loop. This module gives rules a package-wide view:
+
+* one `Node` per function/method at ANY nesting depth (plus a pseudo-node
+  per module for top-level statements), addressed as `module:Qual.path`;
+* resolved call edges: plain names through local scope -> module scope ->
+  `from .x import f` imports; `mod.func(...)` through module aliases;
+  `self.method(...)` through the in-package class hierarchy (bases
+  resolved transitively, cycles tolerated); `obj.method(...)` when `obj`
+  can be typed from a `name = ClassName(...)` / factory-return assignment;
+* `functools.partial` / `jax.jit(fn, ...)` / `shard_map(fn, ...)` call
+  chains unwrapped, accumulating the donation positions, bound mesh axes
+  and positional-argument offset the wrappers introduce — including
+  factories that RETURN a wrapped callable (make_sharded_grow_fn) and are
+  dispatched as `self._grow_fn(...)(args)`;
+* bare function references passed as arguments (`while_loop(cond, body,
+  ..)`, `json.dumps(default=_jsonable)`) become `ref` edges: the callee
+  may run, so reachability-style passes must follow them;
+* anything unresolvable degrades to a conservative may-call edge with
+  `target=None` — passes treat it as an opaque callee, never as proof of
+  absence.
+
+Import cycles are a non-issue (modules are parsed independently; every
+traversal carries a visited set) and recursion terminates the same way.
+The graph is built once per lint run and cached on the Package object.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import FileContext, Package, dotted_name, keyword_arg
+
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def module_name(relpath: str) -> str:
+    """'treelearner/device.py' -> 'treelearner.device'; '__init__.py' -> ''."""
+    rel = relpath
+    if rel.startswith("lightgbm_tpu/"):
+        rel = rel[len("lightgbm_tpu/"):]
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    parts = [p for p in rel.split("/") if p]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+@dataclass(frozen=True)
+class CallableRef:
+    """What a callable expression resolved to, after unwrapping wrappers.
+
+    `target` is a node qual or None (may-call). `donate` holds donated
+    positional indices of the UNDERLYING function, `offset` the number of
+    positionals already bound by partial(), `axes` the mesh axis names a
+    shard_map wrapper binds around the target.
+    """
+
+    target: Optional[str]
+    donate: Tuple[int, ...] = ()
+    axes: FrozenSet[str] = frozenset()
+    offset: int = 0
+    jit_wrapped: bool = False
+
+
+@dataclass
+class Edge:
+    """One call (or callable reference) site."""
+
+    src: str
+    target: Optional[str]          # node qual, or None = may-call unknown
+    call: Optional[ast.Call]       # the Call node (None for bare refs)
+    kind: str                      # "call" | "ref" | "wrap"
+    axes: FrozenSet[str] = frozenset()   # axes bound by wrappers at this site
+    donate: Tuple[int, ...] = ()
+    offset: int = 0
+
+
+@dataclass
+class Node:
+    qual: str                      # "module:Class.method" / "module:<module>"
+    module: str
+    ctx: FileContext
+    node: Optional[ast.AST]        # def node; None for the module pseudo-node
+    cls: Optional[str] = None      # enclosing class name for methods
+    lexical_parent: Optional[str] = None
+    children: Dict[str, str] = field(default_factory=dict)  # name -> qual
+    jitted: bool = False
+    donate: Tuple[int, ...] = ()   # donated positions when called directly
+    returns_callable: Optional[CallableRef] = None
+    returns_classes: Set[str] = field(default_factory=set)  # "module:Class"
+    edges: List[Edge] = field(default_factory=list)
+
+
+class _ModuleEnv:
+    """Per-module name environment: imports, top-level defs, classes."""
+
+    def __init__(self) -> None:
+        self.mod_aliases: Dict[str, str] = {}    # local name -> module name
+        self.sym_imports: Dict[str, Tuple[str, str]] = {}  # name -> (mod, sym)
+        self.top_defs: Dict[str, str] = {}       # name -> node qual
+        self.classes: Dict[str, "_ClassInfo"] = {}
+        self.assigns: Dict[str, ast.AST] = {}    # module-level name = expr
+
+
+@dataclass
+class _ClassInfo:
+    qual: str                       # "module:Class"
+    bases: List[ast.AST]
+    methods: Dict[str, str]         # method name -> node qual
+
+
+def _literal_ints(node: Optional[ast.AST]) -> Tuple[int, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return ()  # non-literal member: degrade to "unknown positions"
+        return tuple(out)
+    return ()
+
+
+def _literal_strs(node: Optional[ast.AST]) -> Tuple[str, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant) and isinstance(e.value, str))
+    return ()
+
+
+def _string_literals(node: ast.AST) -> FrozenSet[str]:
+    return frozenset(sub.value for sub in ast.walk(node)
+                     if isinstance(sub, ast.Constant)
+                     and isinstance(sub.value, str))
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+
+def _jit_decorator_info(fn: ast.AST) -> Tuple[bool, Tuple[int, ...]]:
+    """(is_jitted, donated positions) from the decorator list. Handles
+    @jax.jit, @jit, @partial(jax.jit, donate_argnums=...), donate_argnames
+    mapped onto positional indices."""
+    jitted = False
+    donate: Tuple[int, ...] = ()
+    params = _param_names(fn)
+    for dec in getattr(fn, "decorator_list", []):
+        mentions_jit = any(
+            (isinstance(n, ast.Attribute) and n.attr == "jit")
+            or (isinstance(n, ast.Name) and n.id == "jit")
+            for n in ast.walk(dec))
+        if not mentions_jit:
+            continue
+        jitted = True
+        if isinstance(dec, ast.Call):
+            donate = donate + _literal_ints(keyword_arg(dec, "donate_argnums"))
+            for nm in _literal_strs(keyword_arg(dec, "donate_argnames")):
+                if nm in params:
+                    donate = donate + (params.index(nm),)
+    return jitted, tuple(sorted(set(donate)))
+
+
+class CallGraph:
+    """Package-wide call graph. Build with CallGraph.build(pkg)."""
+
+    def __init__(self) -> None:
+        self.nodes: Dict[str, Node] = {}
+        self.envs: Dict[str, _ModuleEnv] = {}
+        # "module:Class" -> _ClassInfo
+        self.class_table: Dict[str, _ClassInfo] = {}
+        # instance typing: var key -> set of "module:Class".  Keys are
+        # "module:name" for plain names and "module:Class.attr" for
+        # self-attribute assignments.
+        self.instance_types: Dict[str, Set[str]] = {}
+        # functions that become jit boundaries WITHOUT a jit decorator:
+        # `g = jax.jit(f)` aliases, factories returning jit(...) products
+        self.extra_jit_targets: Set[str] = set()
+        self._callers: Optional[Dict[str, List[Edge]]] = None
+
+    # ---------------------------------------------------------------- build
+
+    @classmethod
+    def build(cls, pkg: Package) -> "CallGraph":
+        g = cls()
+        root_pkg = pkg.root.name  # absolute self-imports strip this prefix
+        for ctx in pkg.files:
+            if ctx.tree is None:
+                continue
+            g._index_module(ctx)
+        for ctx in pkg.files:
+            if ctx.tree is None:
+                continue
+            g._scan_imports(ctx, root_pkg)
+        g._type_instances()
+        g._resolve_factory_returns()
+        # module-level `g = jax.jit(f, ...)` aliases make f a jit boundary
+        for mod, env in g.envs.items():
+            for val in env.assigns.values():
+                ref = g._unwrap_callable(val, mod, None, None, set())
+                if ref is not None and ref.target and ref.jit_wrapped:
+                    g.extra_jit_targets.update(ref.target.split("|"))
+        for ctx in pkg.files:
+            if ctx.tree is None:
+                continue
+            g._build_edges(ctx)
+        return g
+
+    def jit_seeds(self) -> Set[str]:
+        """Every node that is a jit boundary: decorator-jitted defs plus
+        functions wrapped by an explicit jax.jit(...) call anywhere."""
+        seeds = {q for q, n in self.nodes.items() if n.jitted}
+        seeds |= {q for q in self.extra_jit_targets if q in self.nodes}
+        return seeds
+
+    def _index_module(self, ctx: FileContext) -> None:
+        mod = module_name(ctx.relpath)
+        env = self.envs.setdefault(mod, _ModuleEnv())
+        mod_node = Node(qual="%s:<module>" % mod, module=mod, ctx=ctx,
+                        node=None)
+        self.nodes[mod_node.qual] = mod_node
+
+        def add_def(fn: ast.AST, prefix: str, cls_name: Optional[str],
+                    parent: Optional[str]) -> str:
+            qual = "%s:%s%s" % (mod, prefix, fn.name)
+            jitted, donate = _jit_decorator_info(fn)
+            node = Node(qual=qual, module=mod, ctx=ctx, node=fn,
+                        cls=cls_name, lexical_parent=parent, jitted=jitted,
+                        donate=donate)
+            self.nodes[qual] = node
+            if parent is not None:
+                self.nodes[parent].children[fn.name] = qual
+            for sub in ast.iter_child_nodes(fn):
+                _walk_nested(sub, qual, prefix + fn.name + ".", cls_name)
+            return qual
+
+        def _walk_nested(node: ast.AST, parent: str, prefix: str,
+                         cls_name: Optional[str]) -> None:
+            if isinstance(node, _DEFS):
+                add_def(node, prefix, cls_name, parent)
+                return
+            for sub in ast.iter_child_nodes(node):
+                _walk_nested(sub, parent, prefix, cls_name)
+
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, _DEFS):
+                qual = add_def(stmt, "", None, None)
+                env.top_defs[stmt.name] = qual
+            elif isinstance(stmt, ast.ClassDef):
+                info = _ClassInfo(qual="%s:%s" % (mod, stmt.name),
+                                  bases=list(stmt.bases), methods={})
+                env.classes[stmt.name] = info
+                self.class_table[info.qual] = info
+                for sub in stmt.body:
+                    if isinstance(sub, _DEFS):
+                        q = add_def(sub, stmt.name + ".", stmt.name, None)
+                        info.methods[sub.name] = q
+                    else:
+                        for n in ast.walk(sub):
+                            if isinstance(n, _DEFS):
+                                break
+            elif isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        env.assigns[tgt.id] = stmt.value
+
+    def _scan_imports(self, ctx: FileContext, root_pkg: str) -> None:
+        mod = module_name(ctx.relpath)
+        env = self.envs[mod]
+        # level=1 resolves to the CONTAINING package: for a plain module
+        # that is mod minus its last segment, for a package __init__ it is
+        # the package itself
+        is_pkg = ctx.relpath.endswith("__init__.py")
+        base0 = mod.split(".") if mod else []
+        if not is_pkg and base0:
+            base0 = base0[:-1]
+
+        def canon(dotted: str) -> str:
+            """Strip the package's own top name from absolute imports."""
+            parts = dotted.split(".")
+            if parts and parts[0] == root_pkg:
+                parts = parts[1:]
+            return ".".join(parts)
+
+        for stmt in ast.walk(ctx.tree):
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    tgt = canon(alias.name)
+                    if tgt in self.envs:
+                        env.mod_aliases[alias.asname or
+                                        alias.name.split(".")[0]] = tgt
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.level:
+                    up = stmt.level - 1
+                    if up > len(base0):
+                        continue
+                    base = base0[:len(base0) - up] if up else list(base0)
+                    src = ".".join(base + (stmt.module or "").split("."))
+                    src = src.strip(".")
+                else:
+                    src = canon(stmt.module or "")
+                for alias in stmt.names:
+                    name = alias.asname or alias.name
+                    as_mod = (src + "." + alias.name).strip(".") \
+                        if src else alias.name
+                    if as_mod in self.envs:
+                        # `from . import telemetry` — a module import
+                        env.mod_aliases[name] = as_mod
+                    elif src in self.envs:
+                        env.sym_imports[name] = (src, alias.name)
+
+    # ------------------------------------------------------ symbol lookup
+
+    def _module_symbol(self, mod: str, name: str,
+                       seen: Optional[Set[str]] = None) -> Optional[str]:
+        """Resolve `name` in module `mod` to a node/class qual, following
+        re-export chains (from .x import f) with a cycle guard."""
+        seen = set() if seen is None else seen
+        key = "sym:%s:%s" % (mod, name)
+        if key in seen:
+            return None
+        seen.add(key)
+        env = self.envs.get(mod)
+        if env is None:
+            return None
+        if name in env.top_defs:
+            return env.top_defs[name]
+        if name in env.classes:
+            return env.classes[name].qual
+        if name in env.sym_imports:
+            src, sym = env.sym_imports[name]
+            return self._module_symbol(src, sym, seen)
+        if name in env.assigns:
+            ref = self._unwrap_callable(env.assigns[name], mod, None, None,
+                                        seen)
+            if ref is not None and ref.target is not None:
+                return ref.target
+        return None
+
+    def _class_info(self, qual: str) -> Optional[_ClassInfo]:
+        return self.class_table.get(qual)
+
+    def _resolve_base(self, base: ast.AST, mod: str) -> Optional[str]:
+        name = dotted_name(base)
+        if not name:
+            return None
+        parts = name.split(".")
+        env = self.envs.get(mod)
+        if env is None:
+            return None
+        if len(parts) == 1:
+            sym = self._module_symbol(mod, parts[0])
+            return sym if sym in self.class_table else None
+        if parts[0] in env.mod_aliases and len(parts) == 2:
+            sym = self._module_symbol(env.mod_aliases[parts[0]], parts[1])
+            return sym if sym in self.class_table else None
+        return None
+
+    def mro(self, class_qual: str) -> List[str]:
+        """Linearized in-package ancestry (order: class, then bases,
+        breadth-first). Unresolvable bases simply drop out — callers must
+        treat a miss as may-call, not absence."""
+        out: List[str] = []
+        frontier = [class_qual]
+        seen: Set[str] = set()
+        while frontier:
+            q = frontier.pop(0)
+            if q in seen:
+                continue
+            seen.add(q)
+            info = self.class_table.get(q)
+            if info is None:
+                continue
+            out.append(q)
+            mod = q.split(":", 1)[0]
+            for b in info.bases:
+                rb = self._resolve_base(b, mod)
+                if rb is not None:
+                    frontier.append(rb)
+        return out
+
+    def method_on(self, class_qual: str, name: str) -> Optional[str]:
+        for q in self.mro(class_qual):
+            info = self.class_table.get(q)
+            if info and name in info.methods:
+                return info.methods[name]
+        return None
+
+    # --------------------------------------------------- instance typing
+
+    def _type_instances(self) -> None:
+        """`x = ClassName(...)` / `self.attr = factory(...)` assignments
+        give `x.method()` / `self.attr.method()` a resolvable receiver."""
+        for qual, node in list(self.nodes.items()):
+            tree = node.node if node.node is not None else node.ctx.tree
+            if tree is None:
+                continue
+            mod = node.module
+            for sub in ast.walk(tree):
+                if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = sub.value
+                if value is None or not isinstance(value, ast.Call):
+                    continue
+                classes = self._classes_of_call(value, mod)
+                if not classes:
+                    continue
+                targets = sub.targets if isinstance(sub, ast.Assign) \
+                    else [sub.target]
+                for tgt in targets:
+                    key = self._var_key(tgt, node)
+                    if key is not None:
+                        self.instance_types.setdefault(key, set()) \
+                            .update(classes)
+
+    def _var_key(self, tgt: ast.AST, node: Node) -> Optional[str]:
+        if isinstance(tgt, ast.Name):
+            return "%s:%s" % (node.module, tgt.id)
+        if (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self" and node.cls):
+            return "%s:%s.%s" % (node.module, node.cls, tgt.attr)
+        return None
+
+    def _classes_of_call(self, call: ast.Call, mod: str) -> Set[str]:
+        name = dotted_name(call.func)
+        if not name:
+            return set()
+        parts = name.split(".")
+        sym: Optional[str] = None
+        env = self.envs.get(mod)
+        if len(parts) == 1:
+            sym = self._module_symbol(mod, parts[0])
+        elif env and parts[0] in env.mod_aliases and len(parts) == 2:
+            sym = self._module_symbol(env.mod_aliases[parts[0]], parts[1])
+        if sym is None:
+            return set()
+        if sym in self.class_table:
+            return {sym}
+        target = self.nodes.get(sym)
+        if target is not None and target.returns_classes:
+            return set(target.returns_classes)
+        return set()
+
+    def _resolve_factory_returns(self) -> None:
+        """Factories returning `ClassName(...)` type their call sites; run
+        to a fixpoint so factory-of-factory chains resolve too."""
+        changed = True
+        guard = 0
+        while changed and guard < 10:
+            changed = False
+            guard += 1
+            for node in self.nodes.values():
+                if node.node is None:
+                    continue
+                for sub in _own_statements(node.node):
+                    if not isinstance(sub, ast.Return) or sub.value is None:
+                        continue
+                    if isinstance(sub.value, ast.Call):
+                        cl = self._classes_of_call(sub.value, node.module)
+                        if cl and not cl <= node.returns_classes:
+                            node.returns_classes |= cl
+                            changed = True
+                ref = self._returned_callable(node)
+                if ref is not None and node.returns_callable is None:
+                    node.returns_callable = ref
+                    changed = True
+            # re-type instances once factory returns are known
+            self._type_instances()
+
+    def _returned_callable(self, node: Node) -> Optional[CallableRef]:
+        """Detect factories that return a wrapped callable: a `return`
+        whose value unwraps to a function (jit/shard_map/partial chains),
+        or a name/subscript assigned from one inside the same function
+        (the `self._grow_fns[key] = make_...(); return self._grow_fns[key]`
+        memoization shape — matched structurally by AST dump)."""
+        if node.node is None:
+            return None
+        assigns: Dict[str, CallableRef] = {}
+        for sub in ast.walk(node.node):
+            if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+                ref = self._unwrap_callable(sub.value, node.module, node,
+                                            node.cls, set())
+                if ref is None or ref.target is None:
+                    continue
+                if not (ref.jit_wrapped or ref.axes or ref.donate
+                        or ref.offset):
+                    continue  # plain `x = fn(...)` calls fn, not aliases it
+                for tgt in sub.targets:
+                    # unparse, not dump: Store vs Load ctx must not break
+                    # the `self._cache[k] = make(...); return self._cache[k]`
+                    # memoization match
+                    assigns[ast.unparse(tgt)] = ref
+        for sub in _own_statements(node.node):
+            if not isinstance(sub, ast.Return) or sub.value is None:
+                continue
+            val = sub.value
+            if isinstance(val, ast.Call):
+                ref = self._unwrap_callable(val, node.module, node, node.cls,
+                                            set())
+                if ref is not None and ref.target is not None:
+                    if ref.jit_wrapped or ref.axes or ref.donate \
+                            or ref.offset:
+                        if ref.jit_wrapped:
+                            self.extra_jit_targets.update(
+                                ref.target.split("|"))
+                        return ref
+                    # a factory returning another factory's product
+                    inner = self.nodes.get(ref.target)
+                    if inner is not None \
+                            and inner.returns_callable is not None:
+                        return inner.returns_callable
+                    # plain `return fn(...)` is a call, not a factory
+                    continue
+            key = ast.unparse(val)
+            if key in assigns:
+                ref = assigns[key]
+                if ref.jit_wrapped and ref.target:
+                    self.extra_jit_targets.update(ref.target.split("|"))
+                return ref
+        return None
+
+    # ----------------------------------------------------- callable exprs
+
+    def _unwrap_callable(self, expr: ast.AST, mod: str, node: Optional[Node],
+                         cls: Optional[str],
+                         seen: Set[str]) -> Optional[CallableRef]:
+        """Resolve an EXPRESSION to the function it denotes (not a call of
+        it): unwraps jit()/shard_map()/partial() wrapper calls and factory
+        returns, accumulating donation/axes/offset."""
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            return self._resolve_name(expr, mod, node, cls, seen)
+        if not isinstance(expr, ast.Call):
+            return None
+        last = dotted_name(expr.func).rsplit(".", 1)[-1]
+        if last == "jit":
+            if not expr.args:
+                return None
+            inner = self._unwrap_callable(expr.args[0], mod, node, cls, seen)
+            if inner is None:
+                return CallableRef(target=None, jit_wrapped=True)
+            donate = _literal_ints(keyword_arg(expr, "donate_argnums"))
+            return CallableRef(inner.target,
+                               tuple(sorted(set(inner.donate + donate))),
+                               inner.axes, inner.offset, True)
+        if last == "shard_map":
+            if not expr.args:
+                return None
+            inner = self._unwrap_callable(expr.args[0], mod, node, cls, seen)
+            axes = _string_literals(expr)
+            if inner is None:
+                return CallableRef(target=None, axes=axes)
+            return CallableRef(inner.target, inner.donate, inner.axes | axes,
+                               inner.offset, inner.jit_wrapped)
+        if last == "partial":
+            if not expr.args:
+                return None
+            inner = self._unwrap_callable(expr.args[0], mod, node, cls, seen)
+            if inner is None:
+                return None
+            return CallableRef(inner.target, inner.donate, inner.axes,
+                               inner.offset + len(expr.args) - 1,
+                               inner.jit_wrapped)
+        if last == "guard":
+            # utils.sanitize.guard(fn, donate, site) dispatches fn unchanged
+            # and only poisons the donated args afterwards — analysis sees
+            # straight through it, merging the guard's literal donate tuple
+            # (so R10 still tracks donation even when the product code
+            # routes the dispatch through the runtime sanitizer).
+            if not expr.args:
+                return None
+            inner = self._unwrap_callable(expr.args[0], mod, node, cls, seen)
+            donate = (_literal_ints(expr.args[1])
+                      if len(expr.args) > 1 else ())
+            if inner is None:
+                return None
+            return CallableRef(inner.target,
+                               tuple(sorted(set(inner.donate + donate))),
+                               inner.axes, inner.offset, inner.jit_wrapped)
+        # a CALL whose target is a factory returning a callable
+        fref = self._unwrap_callable(expr.func, mod, node, cls, seen)
+        if fref is not None and fref.target is not None \
+                and fref.target not in seen:
+            seen.add(fref.target)
+            target = self.nodes.get(fref.target)
+            if target is not None and target.returns_callable is not None:
+                return target.returns_callable
+        return None
+
+    def _resolve_name(self, expr: ast.AST, mod: str, node: Optional[Node],
+                      cls: Optional[str],
+                      seen: Optional[Set[str]] = None) -> Optional[CallableRef]:
+        """Resolve a Name/Attribute expression to a node or class."""
+        env = self.envs.get(mod)
+        if env is None:
+            return None
+        seen = set() if seen is None else seen
+        if isinstance(expr, ast.Name):
+            # lexically nested defs win over module scope
+            cur = node
+            while cur is not None:
+                if expr.id in cur.children:
+                    return CallableRef(cur.children[expr.id])
+                cur = self.nodes.get(cur.lexical_parent) \
+                    if cur.lexical_parent else None
+            # `g = jax.jit(f, donate_argnums=...)`-style aliases: keep the
+            # wrapper's donation/axes instead of collapsing to a bare qual
+            ref = self._alias_ref(expr.id, mod, node, cls, seen)
+            if ref is not None:
+                return ref
+            sym = self._module_symbol(mod, expr.id, seen)
+            if sym is not None:
+                return self._as_callable(sym)
+            return None
+        if not isinstance(expr, ast.Attribute):
+            return None
+        base, attr = expr.value, expr.attr
+        # self.method / cls.method — class-hierarchy dispatch
+        if isinstance(base, ast.Name) and base.id in ("self", "cls") and cls:
+            cq = "%s:%s" % (mod, cls)
+            m = self.method_on(cq, attr)
+            if m is not None:
+                return CallableRef(m)
+            # typed self-attribute: self.attr resolved elsewhere
+            return None
+        # super().method()
+        if (isinstance(base, ast.Call)
+                and dotted_name(base.func) == "super" and cls):
+            info = self.envs[mod].classes.get(cls)
+            if info:
+                for bq in self.mro(info.qual)[1:]:
+                    i2 = self.class_table.get(bq)
+                    if i2 and attr in i2.methods:
+                        return CallableRef(i2.methods[attr])
+            return None
+        # module alias: telemetry.emit(...)
+        name = dotted_name(base)
+        if name in env.mod_aliases:
+            sym = self._module_symbol(env.mod_aliases[name], attr)
+            if sym is not None:
+                return self._as_callable(sym)
+            return None
+        # typed variable / typed self-attribute receiver
+        key: Optional[str] = None
+        if isinstance(base, ast.Name):
+            key = "%s:%s" % (mod, base.id)
+        elif (isinstance(base, ast.Attribute)
+              and isinstance(base.value, ast.Name)
+              and base.value.id == "self" and cls):
+            key = "%s:%s.%s" % (mod, cls, base.attr)
+        if key is not None:
+            hits: Set[str] = set()
+            for cq in self.instance_types.get(key, ()):  # all candidates
+                m = self.method_on(cq, attr)
+                if m is not None:
+                    hits.add(m)
+            if len(hits) == 1:
+                return CallableRef(hits.pop())
+            if hits:
+                # several candidate receivers: the passes get every edge
+                return CallableRef("|".join(sorted(hits)))
+        return None
+
+    def _as_callable(self, sym: str) -> CallableRef:
+        """Calling a class constructs it: route to __init__ when known."""
+        if sym in self.class_table:
+            init = self.method_on(sym, "__init__")
+            if init is not None:
+                return CallableRef(init)
+        return CallableRef(sym)
+
+    def _alias_ref(self, name: str, mod: str, node: Optional[Node],
+                   cls: Optional[str],
+                   seen: Set[str]) -> Optional[CallableRef]:
+        """Wrapper-preserving resolution of `name = jit/shard_map/partial
+        (...)` assignments, nearest scope first. Returns None unless the
+        assignment actually carries wrapper info (plain calls stay calls)."""
+        def from_value(value: ast.AST, key: str) -> Optional[CallableRef]:
+            if key in seen:
+                return None
+            seen.add(key)
+            ref = self._unwrap_callable(value, mod, node, cls, seen)
+            if ref is not None and ref.target is not None and (
+                    ref.donate or ref.axes or ref.offset or ref.jit_wrapped):
+                if ref.jit_wrapped:
+                    self.extra_jit_targets.update(ref.target.split("|"))
+                return ref
+            return None
+
+        if node is not None and node.node is not None:
+            for sub in _own_statements(node.node):
+                if isinstance(sub, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == name
+                        for t in sub.targets):
+                    ref = from_value(sub.value,
+                                     "lassign:%s:%s" % (node.qual, name))
+                    if ref is not None:
+                        return ref
+        env = self.envs.get(mod)
+        if env is not None and name not in env.top_defs \
+                and name in env.assigns:
+            return from_value(env.assigns[name], "assign:%s:%s" % (mod, name))
+        return None
+
+    # ------------------------------------------------------------- edges
+
+    def _build_edges(self, ctx: FileContext) -> None:
+        mod = module_name(ctx.relpath)
+        for node in self.nodes.values():
+            if node.module != mod or node.ctx is not ctx:
+                continue
+            body = node.node if node.node is not None else ctx.tree
+            for call in _own_calls(body):
+                self._edges_for_call(node, call)
+
+    def _edges_for_call(self, node: Node, call: ast.Call) -> None:
+        if isinstance(call.func, (ast.Name, ast.Attribute)):
+            ref = self._resolve_name(call.func, node.module, node, node.cls)
+        else:
+            # direct call of a wrapped expression: jit(shard_map(body))(x)
+            ref = self._unwrap_callable(call.func, node.module, node,
+                                        node.cls, set())
+        last = dotted_name(call.func).rsplit(".", 1)[-1]
+        # shard_map(fn, ...) used as an expression wraps fn: record a wrap
+        # edge so axis-binding passes see the mapping context
+        if last == "shard_map" and call.args:
+            inner = self._unwrap_callable(call.args[0], node.module, node,
+                                          node.cls, set())
+            if inner is not None and inner.target is not None:
+                node.edges.append(Edge(node.qual, inner.target, call, "wrap",
+                                       axes=_string_literals(call)))
+        if last == "jit" and call.args:
+            inner = self._unwrap_callable(call.args[0], node.module, node,
+                                          node.cls, set())
+            if inner is not None and inner.target is not None:
+                self.extra_jit_targets.update(inner.target.split("|"))
+        if ref is None:
+            node.edges.append(Edge(node.qual, None, call, "call"))
+        else:
+            if ref.jit_wrapped and ref.target:
+                self.extra_jit_targets.update(ref.target.split("|"))
+            for tq in (ref.target.split("|") if ref.target else [None]):
+                target = self.nodes.get(tq) if tq else None
+                if target is not None \
+                        and target.returns_callable is not None \
+                        and isinstance(call.func, ast.Call):
+                    # `self._grow_fn(a, b)(args)`: the outer call
+                    # dispatches the factory PRODUCT, not the factory
+                    rc = target.returns_callable
+                    if rc.jit_wrapped and rc.target:
+                        self.extra_jit_targets.update(rc.target.split("|"))
+                    for pq in (rc.target.split("|") if rc.target else [None]):
+                        node.edges.append(Edge(node.qual, pq, call, "call",
+                                               axes=rc.axes,
+                                               donate=rc.donate,
+                                               offset=rc.offset))
+                    continue
+                node.edges.append(Edge(node.qual, tq, call, "call",
+                                       axes=ref.axes, donate=ref.donate,
+                                       offset=ref.offset))
+        # bare function references in arguments: may-run callbacks.  The
+        # first arg of jit/shard_map/partial wrappers is NOT a callback —
+        # it is handled by the wrapper logic above.
+        args = list(call.args)
+        if last in ("jit", "shard_map") and args:
+            args = args[1:]
+        for arg in args + [kw.value for kw in call.keywords]:
+            if isinstance(arg, (ast.Name, ast.Attribute)):
+                r = self._resolve_name(arg, node.module, node, node.cls)
+                if r is not None and r.target is not None:
+                    for tq in r.target.split("|"):
+                        node.edges.append(Edge(node.qual, tq, call, "ref",
+                                               axes=r.axes, donate=r.donate))
+            elif isinstance(arg, ast.Call):
+                # partial(fn, ...) or factory(...) passed as an argument
+                r = self._unwrap_callable(arg, node.module, node, node.cls,
+                                          set())
+                if r is not None and r.target is not None:
+                    if r.jit_wrapped:
+                        self.extra_jit_targets.update(r.target.split("|"))
+                    for tq in r.target.split("|"):
+                        node.edges.append(Edge(node.qual, tq, arg, "ref",
+                                               axes=r.axes, donate=r.donate,
+                                               offset=r.offset))
+
+    # ---------------------------------------------------------- queries
+
+    def callers(self) -> Dict[str, List[Edge]]:
+        if self._callers is None:
+            table: Dict[str, List[Edge]] = {}
+            for node in self.nodes.values():
+                for e in node.edges:
+                    if e.target is not None:
+                        table.setdefault(e.target, []).append(e)
+            self._callers = table
+        return self._callers
+
+    def reachable_from(self, seeds: Iterable[str],
+                       kinds: Sequence[str] = ("call", "ref", "wrap"),
+                       ) -> Set[str]:
+        """Forward closure over resolved edges; may-call edges (target None)
+        contribute nothing — conservatively, the unknown callee's body is
+        invisible rather than assumed-safe AND assumed-reaching."""
+        seen: Set[str] = set()
+        frontier = [q for q in seeds if q in self.nodes]
+        while frontier:
+            q = frontier.pop()
+            if q in seen or q not in self.nodes:
+                continue
+            seen.add(q)
+            for e in self.nodes[q].edges:
+                if e.kind in kinds and e.target is not None \
+                        and e.target not in seen:
+                    frontier.append(e.target)
+        return seen
+
+    def resolve_call(self, node: Node, call: ast.Call) -> List[CallableRef]:
+        """Public resolution for one call site: every candidate callee with
+        its accumulated wrapper info (donation positions, axes, offset).
+        Unknown -> [CallableRef(target=None)]."""
+        if isinstance(call.func, (ast.Name, ast.Attribute)):
+            ref = self._resolve_name(call.func, node.module, node, node.cls)
+        else:
+            ref = self._unwrap_callable(call.func, node.module, node,
+                                        node.cls, set())
+        if ref is None:
+            return [CallableRef(None)]
+        out: List[CallableRef] = []
+        for tq in (ref.target.split("|") if ref.target else [None]):
+            if tq is None:
+                out.append(CallableRef(None))
+                continue
+            target = self.nodes.get(tq)
+            donate, axes, offset = ref.donate, ref.axes, ref.offset
+            if target is not None:
+                if isinstance(call.func, (ast.Name, ast.Attribute)) \
+                        and target.jitted:
+                    donate = tuple(sorted(set(donate + target.donate)))
+                if isinstance(call.func, ast.Call) \
+                        and target.returns_callable is not None:
+                    # self._grow_fn(...)(args): the OUTER call dispatches
+                    # the factory product
+                    rc = target.returns_callable
+                    out.append(rc)
+                    continue
+            out.append(CallableRef(tq, donate, axes, offset,
+                                   ref.jit_wrapped))
+        return out
+
+
+def _own_calls(root: ast.AST):
+    """Call nodes whose innermost enclosing def is `root` (no descent into
+    nested defs — they are their own graph nodes)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _DEFS):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _own_statements(root: ast.AST):
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _DEFS):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def get_callgraph(pkg: Package) -> CallGraph:
+    """Build-once accessor: the graph is shared by every interprocedural
+    rule in a run (and by the cache's dependency computation)."""
+    g = getattr(pkg, "_callgraph", None)
+    if g is None:
+        g = CallGraph.build(pkg)
+        pkg._callgraph = g  # type: ignore[attr-defined]
+    return g
+
+
+def import_deps(pkg: Package) -> Dict[str, Set[str]]:
+    """relpath -> set of relpaths it (transitively) depends on through
+    in-package imports. This is what makes the cache cross-file-aware: a
+    changed module invalidates every file whose closure contains it."""
+    g = get_callgraph(pkg)
+    mod_to_rel = {module_name(c.relpath): c.relpath for c in pkg.files}
+    direct: Dict[str, Set[str]] = {}
+    for ctx in pkg.files:
+        mod = module_name(ctx.relpath)
+        env = g.envs.get(mod)
+        deps: Set[str] = set()
+        if env is not None:
+            for tgt in env.mod_aliases.values():
+                if tgt in mod_to_rel:
+                    deps.add(mod_to_rel[tgt])
+            for src, _sym in env.sym_imports.values():
+                if src in mod_to_rel:
+                    deps.add(mod_to_rel[src])
+        deps.discard(ctx.relpath)
+        direct[ctx.relpath] = deps
+    # transitive closure (iterative; cycles fine)
+    closed: Dict[str, Set[str]] = {}
+    for rel in direct:
+        seen: Set[str] = set()
+        frontier = list(direct[rel])
+        while frontier:
+            d = frontier.pop()
+            if d in seen:
+                continue
+            seen.add(d)
+            frontier.extend(direct.get(d, ()))
+        seen.discard(rel)
+        closed[rel] = seen
+    return closed

@@ -50,6 +50,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="also print suppressed findings with reasons")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule table and exit")
+    parser.add_argument("--format", choices=("text", "sarif"),
+                        default="text", dest="fmt",
+                        help="output format: human text (default) or a "
+                             "SARIF 2.1.0 document on stdout")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="disable the .graftlint_cache/ incremental "
+                             "cache (the CLI caches by default; the "
+                             "run_lint library API never does)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -75,14 +83,40 @@ def main(argv: Optional[List[str]] = None) -> int:
         else None
 
     failed = False
+    all_violations = []
+    all_suppressed = []
     for path in args.paths:
         p = Path(path)
         if not p.exists():
             print("error: no such path: %s" % path, file=sys.stderr)
             return 2
-        result = run_lint(p, select=select, ignore=ignore)
-        print(result.render(show_suppressed=args.show_suppressed))
+        store = None
+        if not args.no_cache:
+            from .cache import CacheStore
+
+            store = CacheStore(p)
+        result = run_lint(p, select=select, ignore=ignore, cache=store)
+        if args.fmt == "sarif":
+            prefix = path.rstrip("/") if p.is_dir() else ""
+            for v in result.violations:
+                all_violations.append((v, prefix))
+            for v in result.suppressed:
+                all_suppressed.append((v, prefix))
+        else:
+            print(result.render(show_suppressed=args.show_suppressed))
         failed |= not result.ok
+    if args.fmt == "sarif":
+        from dataclasses import replace
+
+        from .sarif import render_sarif
+
+        # re-root each finding at its linted directory so one document can
+        # cover several roots; paths then resolve from the repo root
+        def reroot(pairs):
+            return [replace(v, path="%s/%s" % (pre, v.path)) if pre else v
+                    for v, pre in pairs]
+
+        print(render_sarif(reroot(all_violations), reroot(all_suppressed)))
     return 1 if failed else 0
 
 

@@ -181,19 +181,34 @@ def collect(root: Path) -> Package:
 
 
 def run_lint(root, select: Optional[Sequence[str]] = None,
-             ignore: Optional[Sequence[str]] = None) -> LintResult:
+             ignore: Optional[Sequence[str]] = None,
+             cache=None) -> LintResult:
     """Lint every .py under `root` (a package directory or single file).
 
     select/ignore take rule names or R-codes. Suppression directives are
     honored per line; directives that are malformed or reason-less become
     S1 findings themselves (never filtered by select).
+
+    `cache` is an optional `cache.CacheStore`: file-local rules then skip
+    files whose content (and import closure) is unchanged, and the
+    whole-program rules are served from cache on a fully-unchanged tree.
+    The library default is no cache — only the CLI opts in.
     """
-    from .rules import RULES, rule_codes
+    from .rules import RULES, code_families, rule_codes
 
     codes = rule_codes()
+    families = code_families()
 
     def _canon(names: Iterable[str]) -> Set[str]:
-        return {codes.get(n, n) for n in names}
+        # an R-code expands to its whole family (R1 means BOTH the local
+        # and the cross-module jit-sync rules); names pass through
+        out: Set[str] = set()
+        for n in names:
+            if n in families:
+                out.update(families[n])
+            else:
+                out.add(codes.get(n, n))
+        return out
 
     selected = _canon(select) if select else None
     ignored = _canon(ignore) if ignore else set()
@@ -205,12 +220,47 @@ def run_lint(root, select: Optional[Sequence[str]] = None,
             raw.append(Violation("parse-error", "E0", ctx.relpath, 1, 0,
                                  ctx.parse_error))
         raw.extend(ctx.directive_errors)
-    for rule in RULES:
-        if selected is not None and rule.name not in selected:
-            continue
-        if rule.name in ignored:
-            continue
-        raw.extend(rule.check(pkg))
+
+    active = [r for r in RULES
+              if (selected is None or r.name in selected)
+              and r.name not in ignored]
+    local_rules = [r for r in active if not r.whole_program]
+    wp_rules = [r for r in active if r.whole_program]
+
+    if cache is not None:
+        cached_local, invalid, cached_wp = cache.plan(pkg, select, ignore)
+    else:
+        cached_local, invalid, cached_wp = \
+            {}, {ctx.relpath for ctx in pkg.files}, None
+
+    # file-local rules: cached findings for unchanged files, a sub-package
+    # run over just the invalidated ones
+    local_by_file: Dict[str, List[Violation]] = \
+        {ctx.relpath: [] for ctx in pkg.files}
+    for rel, cached in cached_local.items():
+        local_by_file[rel] = list(cached)
+    if invalid:
+        sub = Package(root=pkg.root,
+                      files=[c for c in pkg.files if c.relpath in invalid])
+        for rule in local_rules:
+            for v in rule.check(sub):
+                local_by_file.setdefault(v.path, []).append(v)
+
+    # whole-program rules see the full package whenever anything changed
+    if cached_wp is not None:
+        wp_findings = list(cached_wp)
+    else:
+        wp_findings = []
+        for rule in wp_rules:
+            wp_findings.extend(rule.check(pkg))
+
+    for findings in local_by_file.values():
+        raw.extend(findings)
+    raw.extend(wp_findings)
+    # a full hit (no invalid files, whole-program served) leaves the cache
+    # file already current — skip the save and its call-graph rebuild
+    if cache is not None and (invalid or cached_wp is None):
+        cache.save(pkg, local_by_file, wp_findings, select, ignore)
 
     kept: List[Violation] = []
     suppressed: List[Violation] = []

@@ -9,9 +9,12 @@ from typing import Dict, List
 from .atomic_io import AtomicWriteRule
 from .base import Rule
 from .collective_axis import CollectiveAxisRule
+from .collective_context import CollectiveContextRule
 from .donation import DonationRule
+from .donation_flow import DonationFlowRule
 from .dtype_discipline import DtypeDisciplineRule
 from .jit_boundary import JitBoundaryRule
+from .jit_boundary_xmod import JitBoundaryXModRule
 from .pallas_rules import PallasRule
 from .param_consistency import ParamConsistencyRule
 from .telemetry_hygiene import TelemetryHygieneRule
@@ -27,6 +30,10 @@ RULES: List[Rule] = [
     CollectiveAxisRule(),
     AtomicWriteRule(),
     TelemetryHygieneRule(),
+    # interprocedural passes (call-graph driven; see ../callgraph.py)
+    JitBoundaryXModRule(),
+    DonationFlowRule(),
+    CollectiveContextRule(),
 ]
 
 # rule name -> R-code for ids emitted by rules beyond their primary name
@@ -41,13 +48,25 @@ EXTRA_IDS: Dict[str, str] = {
 def rule_codes() -> Dict[str, str]:
     """Map every accepted identifier (name or code) to the canonical rule
     NAME — used by suppression parsing and --select. Codes shared by
-    several sub-rules (R3) map to the primary name; suppressing by code
-    suppresses the whole family via the 'code alias' entries below."""
+    several sub-rules (R3, R1) map to the FIRST registered name; selecting
+    or suppressing by code covers the whole family (see code_families)."""
     table: Dict[str, str] = {}
     for rule in RULES:
         table[rule.name] = rule.name
-        table[rule.code] = rule.name
+        table.setdefault(rule.code, rule.name)
     for name, code in EXTRA_IDS.items():
         table[name] = name
         table.setdefault(code, name)
     return table
+
+
+def code_families() -> Dict[str, List[str]]:
+    """R-code -> every rule NAME sharing it (R1 covers jit-host-sync AND
+    jit-host-sync-xmod; R3 covers the pallas sub-ids). --select/--ignore
+    by code must expand to the full family."""
+    fams: Dict[str, List[str]] = {}
+    for rule in RULES:
+        fams.setdefault(rule.code, []).append(rule.name)
+    for name, code in EXTRA_IDS.items():
+        fams.setdefault(code, []).append(name)
+    return fams

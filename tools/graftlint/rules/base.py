@@ -18,6 +18,10 @@ class Rule:
     # empty scope_prefixes + empty scope_exact = every file.
     scope_prefixes: Sequence[str] = ()
     scope_exact: Sequence[str] = ()
+    # whole-program rules see cross-file state (call graph, R4's param
+    # table): the incremental cache must rerun them whenever ANY file
+    # changed, while file-local rules rerun only on changed files.
+    whole_program: bool = False
 
     def check(self, pkg: Package) -> Iterable[Violation]:
         raise NotImplementedError

@@ -1,0 +1,134 @@
+"""R11 collective-context: every call-graph path to a collective must bind
+its axis.
+
+R7 proves a collective's axis is bound by a shard_map *in the same
+module*. That leaves exactly one hole, and the sharded device learner
+sits in it: a helper whose `psum` is correctly wrapped when reached
+through `parallel/learners.py` can ALSO be reachable from an unsharded
+jitted entry in another module — that trace has no mesh context and
+fails the moment somebody exercises the second path.
+
+This pass propagates axis REQUIREMENTS bottom-up over the package call
+graph: a function requires the axes of its own literal-axis collectives
+plus whatever its callees require, minus the axes an edge's wrapper
+binds (`shard_map(fn, ...)` wrap edges and factory products like
+`jax.jit(shard_map(body), ...)` both carry their bound axes on the
+edge). Propagation stops at jit boundaries: `jit(f)` with an unbound
+collective inside is broken no matter who calls it, so the finding
+anchors there and does not flood every transitive caller.
+
+A finding is one (origin, axis) pair where the origin is a jit boundary
+whose residual requirement is non-empty, or a root function (no
+in-package callers) with a residual requirement. Non-literal axis names
+and axisless collectives stay R7's findings — this pass only reasons
+about axes it can name. Anchoring follows R6: the def / first decorator
+line, so a standalone suppression sits directly above the entry point
+whose trace is the hazard.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..callgraph import CallGraph, Node, _own_calls, get_callgraph
+from ..core import Package, Violation, dotted_name
+from .base import Rule
+from .collective_axis import _COLLECTIVES, _axis_arg
+
+# witness: where the requirement was born, for the message
+_Witness = Tuple[str, int]  # (relpath, line)
+
+
+class CollectiveContextRule(Rule):
+    name = "collective-context"
+    code = "R11"
+    description = ("collective reachable from a jit boundary or root with "
+                   "no shard_map binding its axis on that call path")
+    scope_prefixes = ("parallel/", "treelearner/", "models/", "ops/")
+    whole_program = True
+
+    def check(self, pkg: Package) -> Iterable[Violation]:
+        graph = get_callgraph(pkg)
+        scoped = {id(c) for c in self.scoped(pkg)}
+        jit_boundary = graph.jit_seeds()
+
+        # own requirements: literal-axis collectives in each node
+        req: Dict[str, Dict[str, _Witness]] = {}
+        for q, node in graph.nodes.items():
+            body = node.node if node.node is not None else node.ctx.tree
+            if body is None or id(node.ctx) not in scoped:
+                continue
+            for call in _own_calls(body):
+                op = dotted_name(call.func).rsplit(".", 1)[-1]
+                if op not in _COLLECTIVES:
+                    continue
+                axis = _axis_arg(call)
+                if isinstance(axis, ast.Constant) \
+                        and isinstance(axis.value, str):
+                    req.setdefault(q, {}).setdefault(
+                        axis.value, (node.ctx.relpath, call.lineno))
+
+        # bottom-up fixpoint over call/ref edges; wrapper-bound axes are
+        # subtracted per edge; jit boundaries absorb (they report locally)
+        changed = True
+        guard = 0
+        while changed and guard < 200:
+            changed = False
+            guard += 1
+            for q, node in graph.nodes.items():
+                for e in node.edges:
+                    if e.target is None or e.kind == "wrap":
+                        continue
+                    if e.target in jit_boundary:
+                        continue  # reported at the boundary itself
+                    for axis, wit in req.get(e.target, {}).items():
+                        if axis in e.axes:
+                            continue
+                        mine = req.setdefault(q, {})
+                        if axis not in mine:
+                            mine[axis] = wit
+                            changed = True
+
+        # a jitted/wrapped node's OWN binding context: axes bound by wrap
+        # edges pointing at it (shard_map(body) inside its factory)
+        bound_at: Dict[str, Set[str]] = {}
+        for node in graph.nodes.values():
+            for e in node.edges:
+                if e.kind == "wrap" and e.target is not None:
+                    bound_at.setdefault(e.target, set()).update(e.axes)
+                elif e.kind == "call" and e.target is not None and e.axes:
+                    # factory-product dispatch: the call's wrapper binds
+                    # these axes around the target
+                    bound_at.setdefault(e.target, set()).update(e.axes)
+
+        callers = graph.callers()
+        out: List[Violation] = []
+        reported: Set[Tuple[str, str]] = set()
+        for q in sorted(req):
+            node = graph.nodes[q]
+            if node.node is None or id(node.ctx) not in scoped:
+                continue
+            residual = {a: w for a, w in req[q].items()
+                        if a not in bound_at.get(q, set())}
+            if not residual:
+                continue
+            is_boundary = q in jit_boundary
+            is_root = not any(e.kind in ("call", "ref")
+                              for e in callers.get(q, ()))
+            if not is_boundary and not is_root:
+                continue
+            for axis, wit in sorted(residual.items()):
+                if (q, axis) in reported:
+                    continue
+                reported.add((q, axis))
+                kind = "jit boundary" if is_boundary else "entry point"
+                anchor = node.node.decorator_list[0] \
+                    if node.node.decorator_list else node.node
+                out.append(self.violation(
+                    node.ctx, anchor,
+                    "%s %r reaches a collective over axis %r (%s:%d) with "
+                    "no shard_map binding it on this path — tracing this "
+                    "entry without a mesh context fails; wrap the dispatch "
+                    "or prove the collective is statically pruned here"
+                    % (kind, q, axis, wit[0], wit[1])))
+        return out

@@ -7,11 +7,13 @@ some configs reach — forces a device→host transfer that serializes the
 dispatch pipeline. On a remote-attached TPU one stray `.item()` in the
 tree-growing wave loop costs more than the histogram kernel it gates.
 
-Reachability is intra-module: functions decorated with `jax.jit` (bare or
-via `partial(jax.jit, ...)`) seed the set, which closes over same-module
-calls by name (including `self.method` calls) and nested defs. Cross-module
-reachability is intentionally out of scope — each hot module is linted on
-its own jitted surface (docs/LINTING.md#r1 for the escape hatch).
+Reachability here is intra-module: functions decorated with `jax.jit`
+(bare or via `partial(jax.jit, ...)`) seed the set, which closes over
+same-module calls by name (including `self.method` calls) and nested
+defs. Cross-module reachability is R1v2's job (jit_boundary_xmod.py):
+the same sink catalogue walked over the package call graph, reporting
+only what this rule cannot see. Both share the R1 code, so disable=R1
+covers the family (docs/LINTING.md#r1 for the escape hatch).
 
 The rule also covers the driver side of the boundary: a host loop that
 pulls each dispatched result straight back (`np.asarray(jitted_fn(x))`

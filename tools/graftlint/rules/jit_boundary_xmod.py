@@ -1,0 +1,327 @@
+"""R1v2 jit-host-sync-xmod: cross-module host-sync reachability.
+
+R1 proper is module-local — its closure stops at the file boundary, so a
+`.item()` in telemetry.py or health.py that is reachable from the jitted
+growth carry (or sits on the per-iteration dispatch path the engine loop
+drives) is invisible to it. This pass walks the package call graph
+instead:
+
+* **trace surface** — the forward closure of every jit boundary
+  (decorator-jitted defs, `jax.jit(fn)` aliases, factory products) over
+  resolved call / callback-ref / shard_map-wrap edges. Any function in
+  that closure runs under trace; the full R1 sink catalogue applies.
+  Functions already covered by the module-local R1 closure are skipped —
+  one finding per defect, owned by the more precise rule.
+* **hot dispatch surface** — functions transitively called from loop
+  bodies inside dispatch-capable functions (functions that themselves
+  reach the trace surface). These run per-iteration on the host side of
+  the boundary: a blocking pull here serializes the dispatch pipeline
+  even though it never traces. To keep this surface from flooding
+  (checkpoint-style cold paths are reachable too), only the
+  unambiguously-blocking sinks are flagged: `.item()` / `.tolist()` /
+  `.block_until_ready()` (including the `getattr(obj, attr)` form looped
+  over a literal method tuple), `bool()` of a non-static value,
+  `np.asarray`/`np.array`, and `jax.device_get`. `int()`/`float()` of
+  scalars stay out — they dominate cold config/checkpoint code and carry
+  no pipeline cost there. Two module groups are excluded: the ones R1
+  already polices (ops/, treelearner/, models/gbdt.py — their loops are
+  checked by R1's own driver-side pass), and the host-API compat layer
+  (basic/engine/sklearn/config/io/models shims), whose contract IS host
+  numpy — per-iteration pulls there are the price of the LightGBM-
+  compatible interface, not a defect this rule can see past. What
+  remains is the hot-loop HOOK surface: telemetry.py, health.py,
+  checkpoint.py, utils/ and parallel/ — instrumentation invoked from
+  inside the dispatch loop, where a hidden sync stalls the pipeline
+  every iteration.
+
+Findings anchor at the SINK, so the fix or the reasoned suppression lives
+next to the offending line in the hook module; the message names the
+cross-module entry that makes the line hot.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..callgraph import (CallGraph, Node, _own_calls, _own_statements,
+                         get_callgraph)
+from ..core import Package, Violation, dotted_name, in_scope
+from .base import Rule, module_functions
+from .jit_boundary import (JitBoundaryRule, _HOST_METHODS, _JAX_HOST,
+                           _is_jitted, _static_under_jit)
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While)
+_NP_PULLS = {"asarray", "array"}
+
+
+def _top_qual(node: Node) -> str:
+    """Map a graph node to the module_functions() qual that v1 checks:
+    nested defs collapse onto their top-level ancestor."""
+    qual = node.qual.split(":", 1)[1]
+    parts = qual.split(".")
+    if node.cls is not None:
+        return ".".join(parts[:2])
+    return parts[0]
+
+
+def _local_v1_closure(ctx) -> Set[str]:
+    """Replicate R1's module-local jit closure (same short-name edges) so
+    this pass never double-reports a sink R1 already owns."""
+    funcs = dict(module_functions(ctx.tree))
+    short: Dict[str, List[str]] = {}
+    for qual in funcs:
+        short.setdefault(qual.rsplit(".", 1)[-1], []).append(qual)
+
+    def callees(fn: ast.AST) -> Set[str]:
+        found: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in short:
+                found.update(short[f.id])
+            elif isinstance(f, ast.Attribute) and f.attr in short:
+                found.update(short[f.attr])
+        return found
+
+    reachable: Set[str] = {q for q, fn in funcs.items() if _is_jitted(fn)}
+    frontier = set(reachable)
+    while frontier:
+        nxt: Set[str] = set()
+        for qual in frontier:
+            nxt |= callees(funcs[qual]) - reachable
+        reachable |= nxt
+        frontier = nxt
+    return reachable
+
+
+def _getattr_sync_names(node: Node) -> Set[str]:
+    """Names bound via `name = getattr(obj, var, ...)` where `var` loops
+    over a literal tuple containing a host-sync method name — telemetry's
+    `for attr in ("item", "tolist"): fn = getattr(v, attr); ... fn()`."""
+    body = node.node if node.node is not None else node.ctx.tree
+    loop_vars: Set[str] = set()
+    for sub in _own_statements(body):
+        if not isinstance(sub, (ast.For, ast.AsyncFor)):
+            continue
+        if not isinstance(sub.target, ast.Name):
+            continue
+        it = sub.iter
+        if isinstance(it, (ast.Tuple, ast.List)) and any(
+                isinstance(e, ast.Constant) and e.value in _HOST_METHODS
+                for e in it.elts):
+            loop_vars.add(sub.target.id)
+    if not loop_vars:
+        return set()
+    names: Set[str] = set()
+    for sub in _own_statements(body):
+        if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call) \
+                and dotted_name(sub.value.func) == "getattr" \
+                and len(sub.value.args) >= 2 \
+                and isinstance(sub.value.args[1], ast.Name) \
+                and sub.value.args[1].id in loop_vars:
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+    return names
+
+
+class JitBoundaryXModRule(Rule):
+    name = "jit-host-sync-xmod"
+    code = "R1"  # same family as jit-host-sync: disable=R1 covers both
+    description = ("host sync reachable from a jit boundary or the hot "
+                   "dispatch loop through a cross-module call chain")
+    # whole-program: the call graph decides what is hot, not the path
+    scope_prefixes = ()
+    scope_exact = ()
+    whole_program = True
+    # pass B only fires inside the hook surface (see module docstring)
+    hook_prefixes = ("parallel/", "utils/")
+    hook_exact = ("telemetry.py", "health.py", "checkpoint.py")
+
+    def check(self, pkg: Package) -> Iterable[Violation]:
+        graph = get_callgraph(pkg)
+        v1 = JitBoundaryRule()
+        locally_covered: Dict[str, Set[str]] = {}
+        for ctx in pkg.files:
+            if ctx.tree is None:
+                continue
+            if in_scope(ctx, v1.scope_prefixes, v1.scope_exact):
+                locally_covered[ctx.relpath] = _local_v1_closure(ctx)
+
+        def covered_by_v1(node: Node) -> bool:
+            cov = locally_covered.get(node.ctx.relpath)
+            return cov is not None and _top_qual(node) in cov
+
+        out: List[Violation] = []
+        seen: Set[Tuple[str, int, int]] = set()
+
+        # ---- pass A: the global trace surface --------------------------
+        parents: Dict[str, Optional[Tuple[str, int]]] = {}
+        frontier: List[str] = []
+        for q in sorted(graph.jit_seeds()):
+            parents[q] = None
+            frontier.append(q)
+        closure: Set[str] = set()
+        while frontier:
+            q = frontier.pop(0)
+            if q in closure or q not in graph.nodes:
+                continue
+            closure.add(q)
+            for e in graph.nodes[q].edges:
+                if e.target is None or e.target in parents:
+                    continue
+                site = (graph.nodes[q].ctx.relpath,
+                        e.call.lineno if e.call is not None else 1)
+                parents[e.target] = site
+                frontier.append(e.target)
+
+        for q in sorted(closure):
+            node = graph.nodes[q]
+            if node.node is None or covered_by_v1(node):
+                continue
+            entry = parents.get(q)
+            via = (" (jit-reachable via %s:%d)" % entry) if entry \
+                else " (jit boundary)"
+            out.extend(self._trace_sinks(node, via, seen))
+
+        # ---- pass B: the hot dispatch surface --------------------------
+        dispatch: Set[str] = set(closure)
+        callers = graph.callers()
+        grew = True
+        while grew:
+            grew = False
+            for q in list(dispatch):
+                for e in callers.get(q, ()):  # who calls into the surface
+                    if e.kind == "call" and e.src not in dispatch:
+                        dispatch.add(e.src)
+                        grew = True
+
+        hot_parents: Dict[str, Tuple[str, int]] = {}
+        hot_frontier: List[str] = []
+        for q in sorted(dispatch):
+            node = graph.nodes[q]
+            body = node.node if node.node is not None else node.ctx.tree
+            if body is None:
+                continue
+            for stmt in _own_statements(body):
+                if not isinstance(stmt, _LOOPS):
+                    continue
+                for call in _own_calls_within(body, stmt):
+                    for ref in graph.resolve_call(node, call):
+                        if ref.target is None:
+                            continue
+                        for tq in ref.target.split("|"):
+                            if tq not in hot_parents:
+                                hot_parents[tq] = (node.ctx.relpath,
+                                                   stmt.lineno)
+                                hot_frontier.append(tq)
+        hot: Set[str] = set()
+        while hot_frontier:
+            q = hot_frontier.pop(0)
+            if q in hot or q not in graph.nodes:
+                continue
+            hot.add(q)
+            for e in graph.nodes[q].edges:
+                if e.target is not None and e.target not in hot_parents:
+                    hot_parents[e.target] = hot_parents[q]
+                    hot_frontier.append(e.target)
+
+        for q in sorted(hot):
+            node = graph.nodes[q]
+            if node.node is None or q in closure:
+                continue
+            if in_scope(node.ctx, v1.scope_prefixes, v1.scope_exact):
+                continue  # R1's own driver-side loop pass owns these
+            if not in_scope(node.ctx, self.hook_prefixes, self.hook_exact):
+                continue  # host-API compat layer: host numpy by contract
+            loop_site = hot_parents[q]
+            out.extend(self._hot_sinks(node, loop_site, seen))
+        return out
+
+    # ------------------------------------------------------------ sinks
+
+    def _trace_sinks(self, node: Node, via: str,
+                     seen: Set[Tuple[str, int, int]]) -> List[Violation]:
+        """Full R1 sink catalogue over the node's own calls (nested defs
+        are their own graph nodes)."""
+        from .jit_boundary import _HOST_BUILTINS, _NP_CALLS
+        out: List[Violation] = []
+        body = node.node
+        qual = node.qual
+        for call in _own_calls(body):
+            f = call.func
+            fname = dotted_name(f)
+            msg = None
+            if isinstance(f, ast.Name) and f.id in _HOST_BUILTINS:
+                if call.args and not all(_static_under_jit(a)
+                                         for a in call.args):
+                    msg = ("%s() concretizes a traced value inside %r%s"
+                           % (f.id, qual, via))
+            elif isinstance(f, ast.Attribute) and f.attr in _HOST_METHODS:
+                msg = (".%s() is a device->host sync inside %r%s"
+                       % (f.attr, qual, via))
+            elif fname.startswith("np.") and fname[3:] in _NP_CALLS:
+                msg = ("%s() pulls traced data to host inside %r%s"
+                       % (fname, qual, via))
+            elif fname in _JAX_HOST:
+                msg = "%s() inside %r%s" % (fname, qual, via)
+            if msg is None:
+                continue
+            key = (node.ctx.relpath, call.lineno, call.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(self.violation(node.ctx, call, msg))
+        return out
+
+    def _hot_sinks(self, node: Node, loop_site: Tuple[str, int],
+                   seen: Set[Tuple[str, int, int]]) -> List[Violation]:
+        out: List[Violation] = []
+        body = node.node
+        qual = node.qual
+        getattr_syncs = _getattr_sync_names(node)
+        where = ("on the hot dispatch path (reached from the loop at "
+                 "%s:%d)" % loop_site)
+        for call in _own_calls(body):
+            f = call.func
+            fname = dotted_name(f)
+            msg = None
+            if isinstance(f, ast.Attribute) and f.attr in _HOST_METHODS:
+                msg = (".%s() blocks per iteration inside %r %s"
+                       % (f.attr, qual, where))
+            elif isinstance(f, ast.Name) and f.id == "bool":
+                if call.args and not all(_static_under_jit(a)
+                                         for a in call.args):
+                    msg = ("bool() forces a device sync inside %r %s"
+                           % (qual, where))
+            elif isinstance(f, ast.Name) and f.id in getattr_syncs:
+                msg = ("call of %r resolved from a host-sync method tuple "
+                       "via getattr inside %r %s" % (f.id, qual, where))
+            elif fname.startswith("np.") and fname[3:] in _NP_PULLS:
+                msg = ("%s() pulls device data to host inside %r %s"
+                       % (fname, qual, where))
+            elif fname == "jax.device_get":
+                msg = "jax.device_get() inside %r %s" % (qual, where)
+            if msg is None:
+                continue
+            key = (node.ctx.relpath, call.lineno, call.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(self.violation(node.ctx, call, msg))
+        return out
+
+
+def _own_calls_within(body: ast.AST, stmt: ast.AST):
+    """Calls inside `stmt` that belong to `body`'s node (no nested defs)."""
+    own = {id(c) for c in _own_calls(body)}
+    stack = [stmt]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n is not stmt:
+            continue
+        if isinstance(n, ast.Call) and id(n) in own:
+            yield n
+        stack.extend(ast.iter_child_nodes(n))

@@ -71,6 +71,7 @@ class ParamConsistencyRule(Rule):
     code = "R4"
     description = ("parameter accepted by the spec/config but never read "
                    "anywhere in the package (the path_smooth defect class)")
+    whole_program = True  # reads usage across every file in the package
 
     def check(self, pkg: Package) -> Iterable[Violation]:
         spec_ctx = None

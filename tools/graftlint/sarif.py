@@ -1,0 +1,99 @@
+"""SARIF 2.1.0 export for graftlint findings.
+
+One run, one tool ("graftlint"), every rule — including driver-level
+finding ids like E0/S1 — declared in the driver's rule table so viewers
+can resolve ruleId without guessing. Suppressed findings are emitted with
+a SARIF `suppressions` entry (`kind: inSource`, the directive's reason as
+the justification) rather than dropped: code-scanning UIs then show them
+as reviewed, matching the linter's own philosophy that an escape hatch is
+a visible artifact, not an omission.
+
+Columns: graftlint's internal `col` is 0-based (ast.col_offset); SARIF
+wants 1-based startColumn.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List
+
+from .core import Violation
+
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+           "Schemata/sarif-schema-2.1.0.json")
+
+
+def _rule_table() -> List[Dict]:
+    from .rules import EXTRA_IDS, RULES
+
+    rules: List[Dict] = []
+    seen = set()
+    for rule in RULES:
+        rules.append({
+            "id": rule.name,
+            "shortDescription": {"text": rule.description},
+            "properties": {"code": rule.code},
+        })
+        seen.add(rule.name)
+    for name, code in sorted(EXTRA_IDS.items(), key=lambda kv: kv[1]):
+        if name in seen:
+            continue
+        rules.append({
+            "id": name,
+            "shortDescription": {
+                "text": "driver-level finding (%s)" % code},
+            "properties": {"code": code},
+        })
+    return rules
+
+
+def _result(v: Violation, uri_prefix: str) -> Dict:
+    uri = "%s/%s" % (uri_prefix.rstrip("/"), v.path) if uri_prefix else v.path
+    res: Dict = {
+        "ruleId": v.rule,
+        "level": "error",
+        "message": {"text": v.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": uri},
+                "region": {"startLine": v.line,
+                           "startColumn": v.col + 1},
+            },
+        }],
+    }
+    if v.suppressed:
+        res["level"] = "note"
+        res["suppressions"] = [{
+            "kind": "inSource",
+            "justification": v.reason,
+        }]
+    return res
+
+
+def to_sarif(violations: Iterable[Violation],
+             suppressed: Iterable[Violation] = (),
+             uri_prefix: str = "") -> Dict:
+    """Build the SARIF document (a plain dict; `render_sarif` serializes).
+
+    `uri_prefix` re-roots the package-relative finding paths for the
+    consumer — CI passes the linted directory ("lightgbm_tpu") so upload
+    artifacts resolve against the repository root.
+    """
+    return {
+        "$schema": _SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "rules": _rule_table(),
+            }},
+            "results": [_result(v, uri_prefix) for v in violations]
+                       + [_result(v, uri_prefix) for v in suppressed],
+        }],
+    }
+
+
+def render_sarif(violations: Iterable[Violation],
+                 suppressed: Iterable[Violation] = (),
+                 uri_prefix: str = "") -> str:
+    return json.dumps(to_sarif(violations, suppressed, uri_prefix),
+                      indent=2, sort_keys=True)
