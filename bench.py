@@ -193,6 +193,45 @@ def run_bench(n_rows: int) -> dict:
         out["predict_rows_per_sec"] = round(n_rows / pe, 1)
         out["predict_chunk_rows"] = pred_chunk
 
+        # serving-layer throughput: an open-loop generator firing fixed-size
+        # requests at the hardened prediction service (docs/SERVING.md) —
+        # micro-batched into the power-of-two buckets warmed at load
+        import threading
+
+        from lightgbm_tpu.serving import PredictionService
+
+        serve_rows = 64
+        serve_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", 300))
+        svc = PredictionService(max_batch_rows=4096, batch_window_s=0.001)
+        try:
+            svc.load_model("bench", booster=bst)
+            span = max(X.shape[0] - serve_rows, 1)
+            served = []
+
+            def fire(i):
+                lo = (i * serve_rows) % span
+                svc.predict("bench", X[lo:lo + serve_rows], raw_score=True)
+                served.append(i)
+
+            t0 = time.perf_counter()
+            threads = []
+            for i in range(serve_requests):
+                th = threading.Thread(target=fire, args=(i,))
+                th.start()
+                threads.append(th)
+                time.sleep(0.0005)  # open loop: fixed arrival rate
+            for th in threads:
+                th.join()
+            serve_s = time.perf_counter() - t0
+            sstats = svc.batcher.stats()
+            out["serve_rows_per_sec"] = round(
+                len(served) * serve_rows / serve_s, 1)
+            out["serve_p50_ms"] = round(sstats.get("p50_ms", 0.0), 3)
+            out["serve_p99_ms"] = round(sstats.get("p99_ms", 0.0), 3)
+            out["serve_batches"] = int(sstats["batches"])
+        finally:
+            svc.close()
+
         # robustness-layer cost: one full-state checkpoint write of the
         # trained model (model text + sidecar, atomic + fsync) ...
         import tempfile
@@ -307,7 +346,9 @@ def main() -> None:
                       "est_carried_bytes_per_wave", "predict_rows_per_sec",
                       "predict_chunk_rows", "checkpoint_write_ms",
                       "guardrail_overhead_pct", "compile_count",
-                      "hbm_high_water_bytes", "telemetry_overhead_pct"):
+                      "hbm_high_water_bytes", "telemetry_overhead_pct",
+                      "serve_rows_per_sec", "serve_p50_ms", "serve_p99_ms",
+                      "serve_batches"):
                 if k in res:
                     record[k] = res[k]
             emit(record)

@@ -536,6 +536,13 @@ class Booster:
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, validate_features: bool = False,
                 **kwargs) -> np.ndarray:
+        if validate_features and _is_dataframe(data):
+            trained = self.feature_name()
+            given = [str(c) for c in data.columns]
+            if trained and given != trained:
+                raise LightGBMError(
+                    f"The features names of the data to predict {given} do "
+                    f"not match the ones used in training {trained}")
         if _is_dataframe(data) and self.pandas_categorical:
             data = _pandas_to_matrix(data, self.pandas_categorical)[0]
         # keep the caller's f32/f64 values: models/gbdt.py routes the device
@@ -546,6 +553,12 @@ class Booster:
             X = data
         else:
             X = _to_2d_float(data)
+        if validate_features:
+            expected = self.num_feature()
+            if expected > 0 and X.shape[1] != expected:
+                raise LightGBMError(
+                    f"The number of features in data ({X.shape[1]}) is not "
+                    f"the same as it was in training data ({expected})")
         if num_iteration is None:
             # best-iteration truncation applies to whole-model predicts only;
             # an explicit start_iteration means "this slice onward"

@@ -204,6 +204,42 @@ def save_checkpoint(booster, path: str, retries: int = 3) -> None:
                    iteration=int(gbdt.iter_), sidecar_bytes=len(blob))
 
 
+def _load_sidecar_payload(sidecar: str):
+    """Validate a sidecar blob (magic + payload sha256) and return its npz;
+    shared by the resume path and the serving upload verifier."""
+    with open(sidecar, "rb") as fh:
+        blob = fh.read()
+    if blob[:len(CKPT_MAGIC)] != CKPT_MAGIC:
+        raise CheckpointError("bad magic")
+    digest = blob[len(CKPT_MAGIC):len(CKPT_MAGIC) + 32]
+    payload = blob[len(CKPT_MAGIC) + 32:]
+    if hashlib.sha256(payload).digest() != digest:
+        raise CheckpointError("payload checksum mismatch")
+    z = np.load(io.BytesIO(payload), allow_pickle=False)
+    manifest = json.loads(bytes(z["manifest"].tobytes()).decode("utf-8"))
+    if int(manifest.get("version", -1)) != CKPT_VERSION:
+        raise CheckpointError(
+            "unsupported checkpoint version %r" % manifest.get("version"))
+    return manifest, z
+
+
+def read_sidecar_manifest(path: str) -> Optional[Dict[str, Any]]:
+    """Serving-upload verifier: the validated sidecar manifest for the
+    model text at `path`, or None when no ``.ckpt`` sidecar exists.
+
+    The manifest's ``model_sha256`` is the content hash the writer vouched
+    for — the model registry compares it against the staged upload before a
+    hot-swap. A sidecar that exists but is damaged raises CheckpointError:
+    for a serving upload that means REJECT (the training-resume path
+    degrades instead — load_checkpoint warns and returns None), because a
+    model swap must never promote bytes the writer did not produce."""
+    sidecar = path + SIDECAR_SUFFIX
+    if not os.path.exists(sidecar):
+        return None
+    manifest, _ = _load_sidecar_payload(sidecar)
+    return manifest
+
+
 def load_checkpoint(path: str) -> Optional[TrainerState]:
     """Validate and load the snapshot pair at `path`. Returns None — with a
     warning naming the failed invariant — whenever the sidecar is absent or
@@ -213,19 +249,7 @@ def load_checkpoint(path: str) -> Optional[TrainerState]:
     if not os.path.exists(sidecar):
         return None
     try:
-        with open(sidecar, "rb") as fh:
-            blob = fh.read()
-        if blob[:len(CKPT_MAGIC)] != CKPT_MAGIC:
-            raise CheckpointError("bad magic")
-        digest = blob[len(CKPT_MAGIC):len(CKPT_MAGIC) + 32]
-        payload = blob[len(CKPT_MAGIC) + 32:]
-        if hashlib.sha256(payload).digest() != digest:
-            raise CheckpointError("payload checksum mismatch")
-        z = np.load(io.BytesIO(payload), allow_pickle=False)
-        manifest = json.loads(bytes(z["manifest"].tobytes()).decode("utf-8"))
-        if int(manifest.get("version", -1)) != CKPT_VERSION:
-            raise CheckpointError(
-                "unsupported checkpoint version %r" % manifest.get("version"))
+        manifest, z = _load_sidecar_payload(sidecar)
         with open(path) as fh:
             model_text = fh.read()
         if (hashlib.sha256(model_text.encode()).hexdigest()

@@ -72,6 +72,8 @@ def run(argv: List[str]) -> int:
         ds.construct()
         ds.save_binary((config.data or "train") + ".bin")
         return 0
+    if task == "serve":
+        return _task_serve(config, params)
     Log.fatal("Unknown task type %s", task)
     return 1
 
@@ -154,6 +156,51 @@ def _task_predict(config: Config, params: Dict[str, str]) -> int:
     np.savetxt(out, np.asarray(pred), fmt="%.9g",
                delimiter="\t" if np.ndim(pred) > 1 else "\n")
     Log.info("Finished prediction, results saved to %s", out)
+    return 0
+
+
+def _task_serve(config: Config, params: Dict[str, str]) -> int:
+    """Hardened prediction server (docs/SERVING.md):
+
+        python -m lightgbm_tpu.cli task=serve input_model=model.txt \\
+            serve_port=8080 serve_model_name=default
+
+    Serve-specific keys are read from the raw params map (Config tolerates
+    unknown keys): serve_host, serve_port, serve_model_name,
+    serve_max_batch_rows, serve_max_queue_rows, serve_batch_window_ms,
+    serve_default_timeout_ms, serve_reject_nonfinite. The model is
+    checksum-verified against its .ckpt sidecar when one exists, and every
+    power-of-two batch bucket is jit-warmed before the socket opens."""
+    if not config.input_model:
+        Log.fatal("No input model, please set input_model=...")
+    from .serving import CircuitBreaker, PredictionService
+    from .serving.http import serve as serve_http
+
+    timeout_ms = params.get("serve_default_timeout_ms")
+    service = PredictionService(
+        max_batch_rows=int(params.get("serve_max_batch_rows", 4096)),
+        max_queue_rows=int(params.get("serve_max_queue_rows", 32768)),
+        batch_window_s=float(params.get("serve_batch_window_ms", 1.0)) / 1e3,
+        default_timeout_s=(float(timeout_ms) / 1e3
+                           if timeout_ms is not None else None),
+        breaker=CircuitBreaker(
+            hbm_limit_bytes=int(params.get("serve_hbm_limit_bytes", 0))))
+    name = params.get("serve_model_name", "default")
+    service.load_model(
+        name, path=config.input_model,
+        reject_nonfinite=params.get("serve_reject_nonfinite", "")
+        in ("1", "true", "True"))
+    server, thread = serve_http(
+        service, host=params.get("serve_host", "127.0.0.1"),
+        port=int(params.get("serve_port", 8080)))
+    Log.info("serving model '%s' from %s; Ctrl-C to stop",
+             name, config.input_model)
+    try:
+        thread.join()
+    except KeyboardInterrupt:
+        Log.info("shutting down")
+        server.shutdown()
+        service.close()
     return 0
 
 

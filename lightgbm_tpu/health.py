@@ -35,6 +35,23 @@ _POLICIES = ("fatal", "warn", "rollback")
 GRAD_CLIP = 1e6  # post-rollback clip bound for gradients/hessians
 
 
+def first_nonfinite_column(X) -> Optional[int]:
+    """Column index of the first non-finite value in a host batch, or None.
+
+    The serving boundary's reuse of the guardrail finiteness machinery: a
+    prediction service with ``reject_nonfinite`` enabled runs this on every
+    request payload BEFORE admission, so a NaN/inf row gets a typed 400
+    naming the offending column instead of a device dispatch. One vectorized
+    isfinite pass on host — NaN stays a legitimate missing value for models
+    that opted out."""
+    import numpy as np
+
+    finite = np.isfinite(X)
+    if finite.all():
+        return None
+    return int(np.argmax(~finite.all(axis=0)))
+
+
 def create_monitor(config) -> Optional["HealthMonitor"]:
     policy = str(getattr(config, "health_check_policy", "") or "").strip()
     if not policy:

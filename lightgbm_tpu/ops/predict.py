@@ -25,6 +25,7 @@ Serving-path machinery on top of the traversal:
 from __future__ import annotations
 
 import os
+import threading
 from collections import OrderedDict, deque
 from dataclasses import dataclass
 from functools import partial
@@ -378,30 +379,43 @@ class PredictorCache:
     list — training an iteration, refit, rollback, loading a model — must
     call invalidate(), which bumps the version and drops every entry. A
     small LRU bound keeps sliced predicts (num_iteration / staged CV
-    evaluation) from pinning unbounded HBM."""
+    evaluation) from pinning unbounded HBM.
+
+    Thread safety: the serving layer hammers `get` from batcher threads
+    while hot-swap / training calls `invalidate` — both mutate the
+    OrderedDict (move_to_end, insert, popitem), so every access holds one
+    lock. The version snapshot is taken INSIDE the lock: a get racing an
+    invalidate either sees the old version's entry (still bit-correct for
+    the tree list it was packed from) or packs fresh under the new version,
+    never a half-evicted entry. Packing on a miss happens under the lock
+    too — concurrent misses for one key must not upload the ensemble
+    twice."""
 
     def __init__(self, capacity: int = 4) -> None:
         self.capacity = capacity
         self._version = 0
         self._entries: "OrderedDict[tuple, PackedEnsemble]" = OrderedDict()
+        self._lock = threading.Lock()
 
     def invalidate(self) -> None:
-        self._version += 1
-        self._entries.clear()
+        with self._lock:
+            self._version += 1
+            self._entries.clear()
 
     def get(self, trees: Sequence[Tree], start: int, end: int,
             dtype=jnp.float32) -> PackedEnsemble:
-        key = (self._version, start, end, np.dtype(dtype).name)
-        hit = self._entries.get(key)
-        if hit is not None:
-            self._entries.move_to_end(key)
-            global_timer.add_count("predict_pack_hits", 1)
-            return hit
-        packed = pack_ensemble(trees[start:end], dtype=dtype)
-        self._entries[key] = packed
-        while len(self._entries) > self.capacity:
-            self._entries.popitem(last=False)
-        return packed
+        with self._lock:
+            key = (self._version, start, end, np.dtype(dtype).name)
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                global_timer.add_count("predict_pack_hits", 1)
+                return hit
+            packed = pack_ensemble(trees[start:end], dtype=dtype)
+            self._entries[key] = packed
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+            return packed
 
 
 # --------------------------------------------------------------- streaming

@@ -1,0 +1,27 @@
+"""Hardened prediction service over the device-resident inference engine.
+
+Layers (each importable on its own):
+
+    errors    typed failures with HTTP statuses
+    registry  named models, checksum-verified atomic hot-swap, host path
+    breaker   CLOSED -> DEGRADED -> OPEN -> HALF_OPEN degradation ladder
+    batcher   micro-batching worker: coalesce, admit, shed, pad, dispatch
+    service   in-process facade: validation, warmup, health, stats
+    http      stdlib ThreadingHTTPServer front (/predict /models /healthz)
+
+See docs/SERVING.md for the batching contract and operational semantics.
+"""
+from .batcher import MicroBatcher
+from .breaker import CircuitBreaker
+from .errors import (DeadlineExceeded, InvalidRequest, ModelLoadError,
+                     ModelNotFound, Overloaded, ServiceClosed, ServingError)
+from .http import ServingHTTPServer, serve
+from .registry import ModelEntry, ModelRegistry
+from .service import PredictionService
+
+__all__ = [
+    "CircuitBreaker", "DeadlineExceeded", "InvalidRequest", "MicroBatcher",
+    "ModelEntry", "ModelLoadError", "ModelNotFound", "ModelRegistry",
+    "Overloaded", "PredictionService", "ServiceClosed", "ServingError",
+    "ServingHTTPServer", "serve",
+]

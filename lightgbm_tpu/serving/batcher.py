@@ -1,0 +1,310 @@
+"""Micro-batching dispatch core: coalesce, bound, shed, degrade.
+
+Concurrent requests for the same (model version, raw_score) are coalesced
+by a single worker thread into one device dispatch, padded to the
+power-of-two bucket sizes (`ops.partition.bucket_size`) the predict jit
+cache already holds — so a steady request mix compiles each bucket once at
+warmup and NEVER again under load, no matter how request sizes jitter.
+
+Admission is bounded by total queued ROWS (not request count — one 4096-row
+request occupies what 4096 single-row requests would): past the bound,
+submit raises Overloaded WITHOUT enqueuing, so a flood cannot grow memory.
+Each request may carry a deadline budget; expired requests are shed at
+batch-assembly time — before any device dispatch — and a caller whose wait
+runs out raises DeadlineExceeded immediately without blocking the batch
+its rows ride in.
+
+The breaker (serving/breaker.py) is consulted per batch: DEGRADED caps the
+chunk rows, OPEN routes to the host-pinned predict path, and a device
+dispatch that throws is retried on the host path in place — the batch's
+callers still get bit-identical answers while the failure feeds the
+breaker.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import telemetry
+from ..ops.partition import bucket_size
+from ..utils import faults
+from ..utils.log import Log
+from ..utils.timer import global_timer
+from .breaker import CircuitBreaker, Decision
+from .errors import (DeadlineExceeded, Overloaded, ServiceClosed,
+                     ServingError)
+from .registry import ModelEntry
+
+
+class _Request:
+    __slots__ = ("entry", "rows", "raw_score", "deadline", "event",
+                 "result", "error", "cancelled", "t_submit")
+
+    def __init__(self, entry: ModelEntry, rows: np.ndarray, raw_score: bool,
+                 deadline: Optional[float]) -> None:
+        self.entry = entry
+        self.rows = rows
+        self.raw_score = raw_score
+        self.deadline = deadline  # absolute monotonic, None = unbounded
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[ServingError] = None
+        self.cancelled = False
+        self.t_submit = time.monotonic()
+
+    def key(self) -> Tuple[int, bool]:
+        # entry identity, not name: a hot-swap mid-queue splits the batch,
+        # so every response comes from the version it was admitted under
+        return (id(self.entry), self.raw_score)
+
+
+class MicroBatcher:
+    def __init__(self, breaker: Optional[CircuitBreaker] = None,
+                 max_batch_rows: int = 4096, max_queue_rows: int = 32768,
+                 min_bucket: int = 256,
+                 batch_window_s: float = 0.001) -> None:
+        self.breaker = breaker or CircuitBreaker()
+        self.max_batch_rows = bucket_size(max(1, max_batch_rows), 1)
+        self.max_queue_rows = max_queue_rows
+        self.min_bucket = bucket_size(max(1, min_bucket), 1)
+        self.batch_window_s = batch_window_s
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self._queued_rows = 0
+        self._closed = False
+        self._latencies_ms: deque = deque(maxlen=4096)
+        # lifetime counters (instance-local: global_timer counters are
+        # process-wide and shared across services/tests)
+        self.n_requests = 0
+        self.n_rows = 0
+        self.n_batches = 0
+        self.n_overloaded = 0
+        self.n_deadline_shed = 0
+        self.n_deadline_wait_expired = 0
+        self.n_device_failures = 0
+        self.n_host_chunks = 0
+        self._worker = threading.Thread(
+            target=self._run, name="lgbm-serve-batcher", daemon=True)
+        self._worker.start()
+
+    # -------------------------------------------------------------- submit
+
+    def submit(self, entry: ModelEntry, rows: np.ndarray, raw_score: bool,
+               timeout_s: Optional[float] = None) -> np.ndarray:
+        """Enqueue one request and block until its batch answers, its
+        deadline expires, or the service closes."""
+        n = int(rows.shape[0])
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        req = _Request(entry, rows, raw_score, deadline)
+        with self._lock:
+            if self._closed:
+                raise ServiceClosed("service is shutting down")
+            if self._queued_rows + n > self.max_queue_rows:
+                self.n_overloaded += 1
+                global_timer.add_count("serve_overloaded", 1)
+                raise Overloaded(
+                    f"admission queue full ({self._queued_rows} rows "
+                    f"queued, request adds {n}, limit "
+                    f"{self.max_queue_rows}); retry with backoff")
+            self._queue.append(req)
+            self._queued_rows += n
+            global_timer.set_count("serve_queue_depth", self._queued_rows)
+            self._cond.notify_all()
+        remaining = None
+        if deadline is not None:
+            remaining = max(0.0, deadline - time.monotonic())
+        if not req.event.wait(remaining):
+            req.cancelled = True  # worker skips it at assembly time
+            self.n_deadline_wait_expired += 1
+            global_timer.add_count("serve_deadline_expired", 1)
+            raise DeadlineExceeded(
+                f"deadline of {timeout_s:.3f}s expired while "
+                f"{'queued' if req.result is None else 'in flight'}")
+        if req.error is not None:
+            raise req.error
+        lat_ms = (time.monotonic() - req.t_submit) * 1000.0
+        with self._lock:
+            self._latencies_ms.append(lat_ms)
+            self.n_requests += 1
+            self.n_rows += n
+        return req.result
+
+    # -------------------------------------------------------------- worker
+
+    def _shed_locked(self, now: float) -> None:
+        """Drop cancelled/expired requests before they cost a dispatch."""
+        live: deque = deque()
+        for req in self._queue:
+            expired = req.deadline is not None and now >= req.deadline
+            if req.cancelled or expired:
+                self._queued_rows -= int(req.rows.shape[0])
+                if not req.cancelled:
+                    req.error = DeadlineExceeded(
+                        "deadline expired before dispatch; request shed "
+                        "from the queue")
+                    req.event.set()
+                self.n_deadline_shed += 1
+                global_timer.add_count("serve_deadline_shed", 1)
+            else:
+                live.append(req)
+        self._queue = live
+        global_timer.set_count("serve_queue_depth", self._queued_rows)
+
+    def _collect(self) -> List[_Request]:
+        """Pull one batch of same-key requests; [] means 'loop again'."""
+        with self._lock:
+            if not self._queue:
+                if self._closed:
+                    return []
+                self._cond.wait(0.05)
+            self._shed_locked(time.monotonic())
+            if not self._queue:
+                return []
+            if (self.batch_window_s > 0
+                    and self._queued_rows < self.min_bucket):
+                # one coalescing beat: let concurrent submitters land so
+                # they share the dispatch instead of each paying their own
+                self._cond.wait(self.batch_window_s)
+                self._shed_locked(time.monotonic())
+                if not self._queue:
+                    return []
+            key = self._queue[0].key()
+            taken: List[_Request] = []
+            rows = 0
+            keep: deque = deque()
+            for req in self._queue:
+                n = int(req.rows.shape[0])
+                # the head is always taken — even oversized (the dispatch
+                # loop chunks it) — so assembly can never spin on it
+                if req.key() == key and (not taken
+                                         or rows + n <= self.max_batch_rows):
+                    taken.append(req)
+                    rows += n
+                else:
+                    keep.append(req)
+            self._queue = keep
+            self._queued_rows -= rows
+            global_timer.set_count("serve_queue_depth", self._queued_rows)
+            return taken
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+            batch = self._collect()
+            if not batch:
+                continue
+            try:
+                self._dispatch(batch)
+            except BaseException as exc:  # worker must outlive any batch
+                for req in batch:
+                    req.error = ServingError(f"prediction failed: {exc}")
+                    req.event.set()
+                Log.warning("serving: batch dispatch error: %s", exc)
+
+    def _pad(self, chunk: np.ndarray, cap: int) -> np.ndarray:
+        """Pad to the power-of-two bucket the jit cache already holds."""
+        n = chunk.shape[0]
+        target = min(bucket_size(n, min(self.min_bucket, cap)), cap)
+        if target <= n:
+            return np.ascontiguousarray(chunk, dtype=np.float32)
+        padded = np.zeros((target, chunk.shape[1]), dtype=np.float32)
+        padded[:n] = chunk
+        return padded
+
+    def _predict_chunk(self, entry: ModelEntry, chunk: np.ndarray,
+                       raw_score: bool, decision: Decision,
+                       cap: int) -> np.ndarray:
+        padded = self._pad(chunk, cap)
+        if decision.use_host:
+            out = entry.predict_host(padded, raw_score)
+            self.breaker.on_success(was_host=True)
+            self.n_host_chunks += 1
+        else:
+            try:
+                faults.on_serve_dispatch()
+                out = entry.predict_device(padded, raw_score)
+                self.breaker.on_success()
+            except Exception as exc:
+                self.breaker.on_failure(exc)
+                self.n_device_failures += 1
+                global_timer.add_count("serve_dispatch_failures", 1)
+                Log.warning("serving: device dispatch failed (%s); "
+                            "retrying this chunk on the host path", exc)
+                if telemetry.enabled():
+                    telemetry.emit("serve_dispatch_failed", error=str(exc),
+                                   rows=int(chunk.shape[0]))
+                out = entry.predict_host(padded, raw_score)
+                self.n_host_chunks += 1
+        return np.asarray(out)[: chunk.shape[0]]
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        entry = batch[0].entry
+        raw_score = batch[0].raw_score
+        X = (batch[0].rows if len(batch) == 1
+             else np.concatenate([r.rows for r in batch], axis=0))
+        n = int(X.shape[0])
+        decision = self.breaker.decide()
+        cap = self.max_batch_rows
+        if decision.max_rows is not None:
+            cap = min(cap, bucket_size(max(1, decision.max_rows), 1))
+        outs = []
+        with global_timer.scope("serve_batch"):
+            for start in range(0, n, cap):
+                outs.append(self._predict_chunk(
+                    entry, X[start:start + cap], raw_score, decision, cap))
+        out = outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+        self.n_batches += 1
+        global_timer.add_count("serve_batches", 1)
+        if telemetry.enabled():
+            telemetry.emit("serve_batch", model=entry.name,
+                           version=entry.version, rows=n,
+                           requests=len(batch), host=decision.use_host)
+        pos = 0
+        for req in batch:
+            k = int(req.rows.shape[0])
+            req.result = out[pos:pos + k]
+            pos += k
+            req.event.set()
+
+    # --------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            lats = sorted(self._latencies_ms)
+            stats = {
+                "queue_rows": self._queued_rows,
+                "requests": self.n_requests,
+                "rows": self.n_rows,
+                "batches": self.n_batches,
+                "overloaded": self.n_overloaded,
+                "deadline_shed": self.n_deadline_shed,
+                "deadline_wait_expired": self.n_deadline_wait_expired,
+                "device_failures": self.n_device_failures,
+                "host_chunks": self.n_host_chunks,
+            }
+        if lats:
+            stats["p50_ms"] = lats[len(lats) // 2]
+            stats["p99_ms"] = lats[min(len(lats) - 1,
+                                       int(len(lats) * 0.99))]
+        return stats
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._cond.notify_all()
+        self._worker.join(timeout=5.0)
+        with self._lock:
+            for req in self._queue:
+                req.error = ServiceClosed("service is shutting down")
+                req.event.set()
+            self._queue.clear()
+            self._queued_rows = 0
+            global_timer.set_count("serve_queue_depth", 0)

@@ -1,0 +1,178 @@
+"""Graceful-degradation circuit breaker for the serving dispatch path.
+
+Four states, strictly ordered by how much they trust the accelerator:
+
+    CLOSED     normal dispatch, full-size chunks
+    DEGRADED   device dispatch with a reduced chunk cap — entered on
+               pressure SIGNALS (jit recompile churn, HBM high-water from
+               the telemetry watchers) rather than hard failures; smaller
+               chunks reuse the coldest part of the jit cache and shrink
+               per-dispatch HBM footprint
+    OPEN       repeated dispatch failures: every request runs the
+               host-pinned predict path (registry.ModelEntry.predict_host)
+               — bit-identical results, no accelerator contact
+    HALF_OPEN  cooldown expired: the next dispatch is a device PROBE; one
+               failure re-opens, `probe_successes` straight successes close
+
+All transitions are driven by the single batcher worker calling
+`decide()` / `on_success()` / `on_failure()` around each dispatch, plus
+`note_signals()` fed from telemetry.signals(); every method is locked so
+health endpoints can read state from other threads. The state code is
+published as the `serve_breaker_state` gauge (0=closed 1=degraded 2=open
+3=half-open).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from .. import telemetry
+from ..utils.log import Log
+from ..utils.timer import global_timer
+
+CLOSED = "closed"
+DEGRADED = "degraded"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+_STATE_CODE = {CLOSED: 0, DEGRADED: 1, OPEN: 2, HALF_OPEN: 3}
+
+
+class Decision:
+    """What the worker should do with the next dispatch."""
+
+    __slots__ = ("use_host", "max_rows", "probe")
+
+    def __init__(self, use_host: bool, max_rows: Optional[int],
+                 probe: bool) -> None:
+        self.use_host = use_host
+        self.max_rows = max_rows  # chunk-row cap, None = no extra cap
+        self.probe = probe
+
+
+class CircuitBreaker:
+    def __init__(self, fail_threshold: int = 3, recovery_successes: int = 8,
+                 probe_successes: int = 3, cooldown_s: float = 2.0,
+                 degraded_rows: int = 256,
+                 compile_churn_limit: int = 8,
+                 hbm_limit_bytes: int = 0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.fail_threshold = max(1, fail_threshold)
+        self.recovery_successes = max(1, recovery_successes)
+        self.probe_successes = max(1, probe_successes)
+        self.cooldown_s = cooldown_s
+        self.degraded_rows = degraded_rows
+        self.compile_churn_limit = compile_churn_limit
+        self.hbm_limit_bytes = hbm_limit_bytes
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._fail_streak = 0
+        self._success_streak = 0
+        self._opened_at = 0.0
+        self._last_compiles: Optional[int] = None
+        self.transitions = 0
+        global_timer.set_count("serve_breaker_state", 0)
+
+    # --------------------------------------------------------------- state
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _move(self, new_state: str, why: str) -> None:
+        # callers hold self._lock
+        if new_state == self._state:
+            return
+        old = self._state
+        self._state = new_state
+        self._fail_streak = 0
+        self._success_streak = 0
+        self.transitions += 1
+        if new_state == OPEN:
+            self._opened_at = self._clock()
+        global_timer.set_count("serve_breaker_state", _STATE_CODE[new_state])
+        Log.warning("serving: breaker %s -> %s (%s)", old, new_state, why)
+        if telemetry.enabled():
+            telemetry.emit("breaker_transition", old=old, new=new_state,
+                           reason=why)
+
+    # ------------------------------------------------------------ dispatch
+
+    def decide(self) -> Decision:
+        """Routing for the next dispatch. In OPEN, a lapsed cooldown flips
+        to HALF_OPEN here so the very next batch is the probe."""
+        with self._lock:
+            if self._state == OPEN:
+                if self._clock() - self._opened_at >= self.cooldown_s:
+                    self._move(HALF_OPEN, "cooldown elapsed, probing device")
+                else:
+                    return Decision(True, None, False)
+            if self._state == HALF_OPEN:
+                return Decision(False, self.degraded_rows, True)
+            if self._state == DEGRADED:
+                return Decision(False, self.degraded_rows, False)
+            return Decision(False, None, False)
+
+    def on_success(self, was_host: bool = False) -> None:
+        if was_host:
+            return  # host fallback says nothing about device health
+        with self._lock:
+            self._fail_streak = 0
+            self._success_streak += 1
+            if (self._state == HALF_OPEN
+                    and self._success_streak >= self.probe_successes):
+                self._move(CLOSED, f"{self.probe_successes} probe "
+                           "dispatches succeeded")
+            elif (self._state == DEGRADED
+                    and self._success_streak >= self.recovery_successes):
+                self._move(CLOSED, f"{self.recovery_successes} clean "
+                           "dispatches at reduced chunk size")
+
+    def on_failure(self, exc: BaseException) -> None:
+        with self._lock:
+            self._success_streak = 0
+            self._fail_streak += 1
+            if self._state == HALF_OPEN:
+                self._move(OPEN, f"probe dispatch failed: {exc}")
+            elif self._fail_streak >= self.fail_threshold:
+                self._move(OPEN, f"{self._fail_streak} consecutive "
+                           f"dispatch failures (last: {exc})")
+
+    # ------------------------------------------------------------- signals
+
+    def note_signals(self, signals: Dict[str, int]) -> None:
+        """Pressure signals from telemetry.signals(): a recompile burst or
+        an HBM high-water breach degrades a CLOSED breaker (smaller chunks)
+        without waiting for an outright failure."""
+        compiles = int(signals.get("compiles", 0))
+        hbm = int(signals.get("hbm_high_water_bytes", 0))
+        with self._lock:
+            prev = self._last_compiles
+            self._last_compiles = compiles
+            if self._state != CLOSED:
+                return
+            if prev is not None and compiles - prev >= self.compile_churn_limit:
+                self._move(DEGRADED, f"jit recompile churn: {compiles - prev} "
+                           "compiles since last check")
+            elif self.hbm_limit_bytes and hbm >= self.hbm_limit_bytes:
+                self._move(DEGRADED, f"HBM high-water {hbm} >= limit "
+                           f"{self.hbm_limit_bytes}")
+
+    def rebaseline(self, signals: Dict[str, int]) -> None:
+        """Reset the compile-churn baseline — called after a model load,
+        whose warmup compiles are expected and must not read as churn."""
+        with self._lock:
+            self._last_compiles = int(signals.get("compiles", 0))
+
+    def info(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self._state,
+                "fail_streak": self._fail_streak,
+                "success_streak": self._success_streak,
+                "transitions": self.transitions,
+                "degraded_rows": self.degraded_rows,
+            }

@@ -1,0 +1,170 @@
+"""PredictionService: the in-process serving facade.
+
+Wires the registry, breaker, and micro-batcher into one object with the
+same surface the HTTP front exposes: predict with a deadline, load/unload
+with checksum verification, health and stats. Request validation happens
+HERE — at the service boundary, before any row is enqueued — so a
+malformed payload (ragged rows, wrong feature count, oversize batch,
+opt-in non-finite values) costs a typed InvalidRequest naming the problem
+and never a device dispatch.
+
+The service polls telemetry.signals() (rate-limited) and feeds the breaker
+so recompile churn or HBM pressure observed by the PR-7 watchers degrades
+chunk sizes before anything actually fails.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..health import first_nonfinite_column
+from .batcher import MicroBatcher
+from .breaker import CircuitBreaker
+from .errors import InvalidRequest, ServiceClosed
+from .registry import ModelRegistry
+
+
+class PredictionService:
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 max_batch_rows: int = 4096, max_queue_rows: int = 32768,
+                 min_bucket: int = 256, batch_window_s: float = 0.001,
+                 max_request_rows: Optional[int] = None,
+                 default_timeout_s: Optional[float] = None,
+                 signal_poll_s: float = 0.25) -> None:
+        self.registry = registry or ModelRegistry()
+        self.breaker = breaker or CircuitBreaker()
+        self.batcher = MicroBatcher(
+            self.breaker, max_batch_rows=max_batch_rows,
+            max_queue_rows=max_queue_rows, min_bucket=min_bucket,
+            batch_window_s=batch_window_s)
+        self.max_request_rows = max_request_rows or self.batcher.max_batch_rows
+        self.default_timeout_s = default_timeout_s
+        self.signal_poll_s = signal_poll_s
+        self._last_signal_poll = 0.0
+        self._started = time.monotonic()
+        self._closed = False
+
+    # -------------------------------------------------------------- models
+
+    def load_model(self, name: str, **kwargs: Any) -> Dict[str, Any]:
+        """Registry load + jit warmup of every serving bucket, so the new
+        version's first live request never pays a compile."""
+        entry = self.registry.load(name, **kwargs)
+        self.warmup(name)
+        # warmup compiles are expected, not churn — don't let them trip the
+        # breaker's recompile signal on the next poll
+        self.breaker.rebaseline(telemetry.signals())
+        return entry.info()
+
+    def unload_model(self, name: str) -> bool:
+        return self.registry.unload(name)
+
+    def models(self) -> List[Dict[str, Any]]:
+        return self.registry.info()
+
+    def warmup(self, name: str, max_rows: Optional[int] = None) -> List[int]:
+        """Dispatch zeros at each power-of-two bucket (both raw and
+        transformed outputs) so the jit cache holds every shape the batcher
+        can produce — the 'zero new compiles under load' contract."""
+        entry = self.registry.get(name)
+        cap = min(max_rows or self.batcher.max_batch_rows,
+                  self.batcher.max_batch_rows)
+        buckets: List[int] = []
+        b = self.batcher.min_bucket
+        while b <= cap:
+            zeros = np.zeros((b, max(entry.n_features, 1)), dtype=np.float32)
+            for raw in (False, True):
+                entry.predict_device(zeros, raw)
+            buckets.append(b)
+            b <<= 1
+        return buckets
+
+    # ------------------------------------------------------------- predict
+
+    def predict(self, model: str, rows: Any, raw_score: bool = False,
+                timeout_s: Optional[float] = None) -> np.ndarray:
+        if self._closed:
+            raise ServiceClosed("service is shutting down")
+        self._poll_signals()
+        entry = self.registry.get(model)
+        X = self._validate(entry, rows)
+        timeout = timeout_s if timeout_s is not None else self.default_timeout_s
+        return self.batcher.submit(entry, X, raw_score, timeout)
+
+    def _validate(self, entry, rows: Any) -> np.ndarray:
+        try:
+            X = np.asarray(rows, dtype=np.float64)
+        except (ValueError, TypeError) as exc:
+            raise InvalidRequest(f"rows are not a numeric matrix: {exc}")
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        if X.ndim != 2:
+            raise InvalidRequest(
+                f"rows must be a 2-D matrix, got {X.ndim}-D")
+        if X.shape[0] == 0:
+            raise InvalidRequest("empty request: no rows")
+        if X.shape[0] > self.max_request_rows:
+            raise InvalidRequest(
+                f"request has {X.shape[0]} rows, per-request limit is "
+                f"{self.max_request_rows}; split the request")
+        if entry.n_features > 0 and X.shape[1] != entry.n_features:
+            raise InvalidRequest(
+                f"request rows have {X.shape[1]} features, model "
+                f"'{entry.name}' v{entry.version} expects {entry.n_features}")
+        if entry.reject_nonfinite:
+            col = first_nonfinite_column(X)
+            if col is not None:
+                raise InvalidRequest(
+                    f"non-finite value in feature column {col}; model "
+                    f"'{entry.name}' was registered with reject_nonfinite "
+                    "(NaN-as-missing disabled)")
+        return np.ascontiguousarray(X, dtype=np.float32)
+
+    # ------------------------------------------------------------- signals
+
+    def _poll_signals(self) -> None:
+        now = time.monotonic()
+        if now - self._last_signal_poll < self.signal_poll_s:
+            return
+        self._last_signal_poll = now
+        self.breaker.note_signals(telemetry.signals())
+
+    # -------------------------------------------------------------- health
+
+    def healthz(self) -> Dict[str, Any]:
+        stats = self.batcher.stats()
+        breaker = self.breaker.info()
+        status = "ok"
+        if breaker["state"] != "closed":
+            status = "degraded"
+        if self._closed:
+            status = "closing"
+        return {
+            "status": status,
+            "uptime_s": round(time.monotonic() - self._started, 3),
+            "models": len(self.registry.names()),
+            "rejected_uploads": self.registry.rejected_uploads,
+            "breaker": breaker,
+            "queue": stats,
+        }
+
+    def readyz(self) -> Dict[str, Any]:
+        ready = not self._closed and bool(self.registry.names())
+        return {"ready": ready, "models": self.registry.names()}
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "batcher": self.batcher.stats(),
+            "breaker": self.breaker.info(),
+            "models": self.registry.info(),
+            "swaps": self.registry.swaps,
+            "rejected_uploads": self.registry.rejected_uploads,
+        }
+
+    def close(self) -> None:
+        self._closed = True
+        self.batcher.close()

@@ -89,6 +89,21 @@ def sample_hbm() -> int:
     return s.hbm.sample() if s is not None else 0
 
 
+def signals() -> Dict[str, int]:
+    """Cheap watcher snapshot for adaptive consumers — the serving circuit
+    breaker polls this between batches to detect compile churn and HBM
+    pressure without owning the watchers. Two ints read from the active
+    session (zeros when no session is recording): total jit cache misses
+    seen by the recompile watcher, and the per-device HBM high-water."""
+    s = _session
+    if s is None:
+        return {"compiles": 0, "hbm_high_water_bytes": 0}
+    return {
+        "compiles": s.recompiles.total if s.recompiles is not None else 0,
+        "hbm_high_water_bytes": max(s.hbm.high_water.values(), default=0),
+    }
+
+
 def resolve_dir(params: Optional[Dict[str, Any]]) -> str:
     """Output dir from the `telemetry_dir` param, else $LGBM_TPU_TELEMETRY."""
     return str((params or {}).get("telemetry_dir") or ""

@@ -17,6 +17,20 @@ programmatically (tests call ``install``/``clear``) or read once from the
     ckpt_truncate       truncate the next model-text artifact to half its
                         size AFTER it is durably written
 
+Serving faults (lightgbm_tpu/serving/, docs/SERVING.md) — the dispatch
+counter counts device dispatches through the serving batcher, 1-based:
+
+    slow_predict@N[:secs]    every device dispatch from the Nth onward
+                             sleeps `secs` (default 0.05) before running —
+                             the slow-device stand-in that saturates the
+                             admission queue in open-loop load tests
+    predict_fail@N[:count]   dispatches N..N+count-1 raise InjectedFault
+                             (default count 3) — trips the circuit breaker,
+                             then lets it recover once the window passes
+    model_corrupt_upload     garble the NEXT staged model upload before the
+                             registry verifies it (one-shot) — the checksum
+                             gate must reject it and keep the prior version
+
 Every injection is one-shot (``kill@K`` fires once even if iteration K is
 re-entered after a rollback) and seeded, so a failing fault test replays
 exactly. All hooks are cheap no-ops when no plan is armed — the boosting
@@ -45,6 +59,12 @@ class FaultPlan:
         self.write_fails = 0
         self.corrupt_sidecar = False
         self.truncate_model = False
+        self.slow_predict_at: Optional[int] = None
+        self.slow_predict_s = 0.05
+        self.fail_predict_at: Optional[int] = None
+        self.fail_predict_count = 3
+        self.corrupt_upload = False
+        self._dispatch_no = 0  # serving device-dispatch counter (1-based)
         self._fired = set()
         for token in (t.strip() for t in self.spec.split(",")):
             if not token:
@@ -64,6 +84,24 @@ class FaultPlan:
                 self.corrupt_sidecar = True
             elif token == "ckpt_truncate":
                 self.truncate_model = True
+            elif token.startswith("slow_predict@"):
+                body = token[len("slow_predict@"):]
+                if ":" in body:
+                    at, secs = body.split(":", 1)
+                    self.slow_predict_at, self.slow_predict_s = (
+                        int(at), float(secs))
+                else:
+                    self.slow_predict_at = int(body)
+            elif token.startswith("predict_fail@"):
+                body = token[len("predict_fail@"):]
+                if ":" in body:
+                    at, cnt = body.split(":", 1)
+                    self.fail_predict_at, self.fail_predict_count = (
+                        int(at), int(cnt))
+                else:
+                    self.fail_predict_at = int(body)
+            elif token == "model_corrupt_upload":
+                self.corrupt_upload = True
             else:
                 Log.fatal("Unknown fault token %r in fault spec %r",
                           token, self.spec)
@@ -163,6 +201,42 @@ def maybe_corrupt_artifact(path: str) -> None:
         Log.warning("Fault injection: truncated %s to %d bytes",
                     path, size // 2)
         _emit_fault("truncate", path=path)
+
+
+def on_serve_dispatch() -> None:
+    """Injection point just before a serving device dispatch (one call per
+    batch the micro-batcher sends to the device). Counts dispatches and
+    applies the armed slow/fail serving faults in that order, so a single
+    plan can model a device that is first slow and then dies."""
+    p = _get()
+    if p.slow_predict_at is None and p.fail_predict_at is None:
+        return
+    p._dispatch_no += 1
+    no = p._dispatch_no
+    if p.slow_predict_at is not None and no >= p.slow_predict_at:
+        import time
+
+        _emit_fault("slow_predict", dispatch=no, seconds=p.slow_predict_s)
+        time.sleep(p.slow_predict_s)
+    if p.fail_predict_at is not None and \
+            p.fail_predict_at <= no < p.fail_predict_at + p.fail_predict_count:
+        _emit_fault("predict_fail", dispatch=no)
+        raise InjectedFault(
+            f"injected fault: device dispatch {no} failed")
+
+
+def maybe_corrupt_upload(text: str) -> str:
+    """Injection point in the model registry's staged-load path: garble the
+    upload BEFORE verification (one-shot). Digits flip too, so even a parse
+    that survives the '#' noise cannot reproduce the original checksum."""
+    p = _get()
+    if not p.corrupt_upload or not p.once("corrupt_upload"):
+        return text
+    mid = len(text) // 2
+    _emit_fault("corrupt_upload", bytes=64)
+    Log.warning("Fault injection: corrupted staged model upload "
+                "(%d chars garbled)", min(64, len(text) - mid))
+    return text[:mid] + "#" * min(64, len(text) - mid) + text[mid + 64:]
 
 
 def _emit_fault(kind: str, **fields) -> None:

@@ -58,3 +58,9 @@ def test_bench_smoke_cpu():
     assert record["compile_count"] > 0
     assert record["hbm_high_water_bytes"] >= 0
     assert isinstance(record["telemetry_overhead_pct"], float)
+    # serving-layer metrics: the open-loop generator drove the hardened
+    # prediction service and every request was micro-batched and answered
+    assert record["serve_rows_per_sec"] > 0
+    assert record["serve_p50_ms"] > 0
+    assert record["serve_p99_ms"] >= record["serve_p50_ms"]
+    assert record["serve_batches"] > 0

@@ -183,3 +183,38 @@ def test_truncated_model_fails_fast_with_filename(tmp_path):
 def test_unknown_fault_token_is_fatal():
     with pytest.raises(LightGBMError):
         faults.install("explode@3")
+
+
+def test_serving_fault_tokens_parse():
+    p = faults.FaultPlan("slow_predict@3")
+    assert p.slow_predict_at == 3 and p.slow_predict_s == 0.05
+    p = faults.FaultPlan("slow_predict@2:0.5")
+    assert p.slow_predict_at == 2 and p.slow_predict_s == 0.5
+    p = faults.FaultPlan("predict_fail@4")
+    assert p.fail_predict_at == 4 and p.fail_predict_count == 3
+    p = faults.FaultPlan("predict_fail@1:7,model_corrupt_upload")
+    assert p.fail_predict_at == 1 and p.fail_predict_count == 7
+    assert p.corrupt_upload
+    with pytest.raises(LightGBMError):
+        faults.FaultPlan("predict_slow@1")  # unknown token stays fatal
+
+
+def test_on_serve_dispatch_window():
+    faults.install("predict_fail@2:2")
+    faults.on_serve_dispatch()  # dispatch 1: before the window
+    for _ in range(2):  # dispatches 2-3: inside
+        with pytest.raises(InjectedFault):
+            faults.on_serve_dispatch()
+    faults.on_serve_dispatch()  # dispatch 4: window passed
+    faults.clear()
+    # disarmed plans must not count dispatches at all
+    faults.on_serve_dispatch()
+    assert faults._get()._dispatch_no == 0
+
+
+def test_corrupt_upload_fires_once():
+    faults.install("model_corrupt_upload")
+    text = "x" * 4096
+    first = faults.maybe_corrupt_upload(text)
+    assert first != text and len(first) == len(text)
+    assert faults.maybe_corrupt_upload(text) == text  # one-shot

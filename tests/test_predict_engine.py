@@ -147,3 +147,40 @@ def test_pred_chunk_rows_param_accepted(rng):
     np.testing.assert_array_equal(np.asarray(single)[:, 0]
                                   if np.asarray(single).ndim > 1
                                   else np.asarray(single), via_params)
+
+def test_predictor_cache_thread_safety_under_invalidate(rng):
+    """Regression: PredictorCache's OrderedDict was mutated without a lock;
+    concurrent predicts racing an invalidate() could corrupt the LRU or
+    serve a stale-version pack. Hammer predict from many threads across
+    repeated invalidations and assert every output stays bit-identical."""
+    import threading
+
+    bst, X, _ = _train_binary(rng)
+    cache = bst._gbdt._predictor
+    expected = bst.predict(X)
+    expected_sliced = bst.predict(X, num_iteration=2)
+    errors = []
+    stop = threading.Event()
+
+    def hammer():
+        try:
+            while not stop.is_set():
+                np.testing.assert_array_equal(bst.predict(X), expected)
+                np.testing.assert_array_equal(
+                    bst.predict(X, num_iteration=2), expected_sliced)
+        except Exception as exc:
+            errors.append(exc)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for _ in range(30):
+        cache.invalidate()
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors[0]
+    # the cache is still a consistent LRU afterwards: bounded and reusable
+    bst.predict(X)
+    assert len(cache._entries) <= cache.capacity
+    np.testing.assert_array_equal(bst.predict(X), expected)

@@ -1,0 +1,470 @@
+"""Hardened-serving suite: bit-identity with direct Booster.predict on both
+the device and host-fallback paths, checksum-verified hot-swap that a
+corrupt upload can never win, breaker trip -> host fallback -> half-open
+recovery, deadline shedding before dispatch, bounded admission, and the
+end-to-end fault-injected acceptance scenario.
+"""
+import hashlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import checkpoint
+from lightgbm_tpu.serving import (CircuitBreaker, DeadlineExceeded,
+                                  InvalidRequest, ModelLoadError,
+                                  ModelNotFound, Overloaded,
+                                  PredictionService)
+from lightgbm_tpu.serving.breaker import CLOSED, DEGRADED, HALF_OPEN, OPEN
+from lightgbm_tpu.utils import faults
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.1,
+          "verbosity": -1, "min_data_in_leaf": 5}
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.clear()
+
+
+def _train(rng, n=500, rounds=8, params=None):
+    X = rng.rand(n, 10)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y)
+    return lgb.train(params or PARAMS, ds, num_boost_round=rounds), X, y
+
+
+def _service(**kw):
+    kw.setdefault("batch_window_s", 0.0)
+    kw.setdefault("max_batch_rows", 1024)
+    return PredictionService(**kw)
+
+
+# ------------------------------------------------------------ bit-identity
+
+
+def test_served_predictions_bit_identical_to_direct(rng):
+    bst, _, _ = _train(rng)
+    svc = _service()
+    try:
+        svc.load_model("m", booster=bst)
+        for n in (1, 37, 300):
+            Q = rng.rand(n, 10)
+            assert np.array_equal(svc.predict("m", Q), bst.predict(Q))
+            assert np.array_equal(svc.predict("m", Q, raw_score=True),
+                                  bst.predict(Q, raw_score=True))
+    finally:
+        svc.close()
+
+
+def test_host_fallback_bit_identical(rng):
+    bst, _, _ = _train(rng)
+    svc = _service()
+    try:
+        svc.load_model("m", booster=bst)
+        entry = svc.registry.get("m")
+        Q = np.ascontiguousarray(rng.rand(64, 10), dtype=np.float32)
+        for raw in (False, True):
+            assert np.array_equal(entry.predict_host(Q, raw),
+                                  entry.predict_device(Q, raw))
+    finally:
+        svc.close()
+
+
+def test_multiclass_and_regression_served(rng):
+    X = rng.rand(400, 8)
+    y_mc = (X[:, 0] * 3).astype(int).clip(0, 2).astype(np.float64)
+    mc = lgb.train({"objective": "multiclass", "num_class": 3,
+                    "num_leaves": 7, "verbosity": -1},
+                   lgb.Dataset(X, label=y_mc), num_boost_round=5)
+    reg = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=X[:, 0]), num_boost_round=5)
+    svc = _service()
+    try:
+        svc.load_model("mc", booster=mc)
+        svc.load_model("reg", booster=reg)
+        Q = rng.rand(33, 8)
+        assert np.array_equal(svc.predict("mc", Q), mc.predict(Q))
+        assert np.array_equal(svc.predict("reg", Q), reg.predict(Q))
+    finally:
+        svc.close()
+
+
+def test_concurrent_mixed_size_requests_bit_identical(rng):
+    bst, _, _ = _train(rng)
+    svc = PredictionService(batch_window_s=0.002, max_batch_rows=1024)
+    try:
+        svc.load_model("m", booster=bst)
+        queries = [rng.rand(int(n), 10) for n in
+                   rng.randint(1, 200, size=24)]
+        expected = [bst.predict(q) for q in queries]
+        results = [None] * len(queries)
+        errors = []
+
+        def worker(i):
+            try:
+                results[i] = svc.predict("m", queries[i])
+            except Exception as exc:  # pragma: no cover - diagnostic
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(queries))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        for got, want in zip(results, expected):
+            assert np.array_equal(got, want)
+    finally:
+        svc.close()
+
+
+# ----------------------------------------------------------- admission
+
+
+def test_validation_rejects_before_dispatch(rng):
+    bst, _, _ = _train(rng)
+    svc = _service()
+    try:
+        svc.load_model("m", booster=bst)
+        with pytest.raises(InvalidRequest, match="9 features"):
+            svc.predict("m", rng.rand(3, 9))
+        with pytest.raises(InvalidRequest, match="numeric"):
+            svc.predict("m", [[1.0, 2.0], [3.0]])
+        with pytest.raises(InvalidRequest, match="no rows"):
+            svc.predict("m", np.zeros((0, 10)))
+        with pytest.raises(InvalidRequest, match="per-request limit"):
+            svc.predict("m", np.zeros((svc.max_request_rows + 1, 10)))
+        with pytest.raises(ModelNotFound):
+            svc.predict("nope", rng.rand(1, 10))
+    finally:
+        svc.close()
+
+
+def test_nonfinite_rejection_is_opt_in(rng):
+    bst, _, _ = _train(rng)
+    svc = _service()
+    try:
+        svc.load_model("nan_ok", booster=bst)
+        svc.load_model("strict", booster=bst, reject_nonfinite=True)
+        Q = rng.rand(5, 10)
+        Q[2, 7] = np.nan
+        # NaN is a legitimate missing value by default (LightGBM semantics)
+        direct = bst.predict(Q)
+        assert np.array_equal(svc.predict("nan_ok", Q), direct)
+        with pytest.raises(InvalidRequest, match="column 7"):
+            svc.predict("strict", Q)
+    finally:
+        svc.close()
+
+
+def test_overload_rejects_without_enqueuing(rng):
+    bst, _, _ = _train(rng)
+    svc = _service(max_queue_rows=256)
+    try:
+        svc.load_model("m", booster=bst)
+        faults.install("slow_predict@1:0.2")  # hold the worker busy
+        Q = rng.rand(100, 10)
+        svc_errors = []
+        done = []
+
+        def worker():
+            try:
+                done.append(svc.predict("m", Q))
+            except Overloaded as exc:
+                svc_errors.append(exc)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+            time.sleep(0.005)  # let earlier submits claim queue slots
+        for t in threads:
+            t.join()
+        assert svc_errors, "saturation never produced an Overloaded"
+        # bounded admission: queued rows never exceeded the limit
+        assert svc.batcher.stats()["queue_rows"] == 0
+        assert svc.batcher.n_overloaded == len(svc_errors)
+        # accepted requests still answered correctly
+        for out in done:
+            assert np.array_equal(out, bst.predict(Q))
+    finally:
+        svc.close()
+
+
+# ------------------------------------------------------------- deadlines
+
+
+def test_deadline_expired_request_is_shed_before_dispatch(rng):
+    bst, _, _ = _train(rng)
+    svc = _service()
+    try:
+        svc.load_model("m", booster=bst)
+        faults.install("slow_predict@1:0.25")
+        Q = rng.rand(32, 10)
+        dispatches_before = svc.batcher.n_batches
+
+        slow_ok = []
+        t_slow = threading.Thread(
+            target=lambda: slow_ok.append(svc.predict("m", Q)))
+        t_slow.start()
+        time.sleep(0.02)  # slow batch is now holding the worker
+        with pytest.raises(DeadlineExceeded):
+            svc.predict("m", Q, timeout_s=0.05)
+        t_slow.join()
+        # the expired request was shed at assembly time: the worker ran the
+        # slow batch and nothing else ever reached a dispatch
+        deadline = time.monotonic() + 2.0
+        while (svc.batcher.n_deadline_shed == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert svc.batcher.n_deadline_shed >= 1
+        assert svc.batcher.n_batches == dispatches_before + 1
+        assert slow_ok and np.array_equal(slow_ok[0], bst.predict(Q))
+    finally:
+        svc.close()
+
+
+def test_expired_inflight_wait_does_not_block_batch(rng):
+    bst, _, _ = _train(rng)
+    svc = _service()
+    try:
+        svc.load_model("m", booster=bst)
+        faults.install("slow_predict@1:0.2")
+        Q = rng.rand(16, 10)
+        t0 = time.monotonic()
+        with pytest.raises(DeadlineExceeded):
+            svc.predict("m", Q, timeout_s=0.05)
+        # the caller came back at its deadline, not after the slow batch
+        assert time.monotonic() - t0 < 0.15
+    finally:
+        svc.close()
+
+
+# --------------------------------------------------------------- hot-swap
+
+
+def test_hot_swap_and_idempotent_reload(rng):
+    bst1, X, y = _train(rng)
+    bst2 = lgb.train({**PARAMS, "num_leaves": 7},
+                     lgb.Dataset(X, label=y), num_boost_round=4)
+    svc = _service()
+    try:
+        v1 = svc.load_model("m", booster=bst1)
+        assert v1["version"] == 1
+        # idempotent retry: same bytes, same version
+        assert svc.load_model("m", booster=bst1)["version"] == 1
+        Q = rng.rand(20, 10)
+        assert np.array_equal(svc.predict("m", Q), bst1.predict(Q))
+        v2 = svc.load_model("m", booster=bst2)
+        assert v2["version"] == 2
+        assert np.array_equal(svc.predict("m", Q), bst2.predict(Q))
+    finally:
+        svc.close()
+
+
+def test_corrupt_upload_never_replaces_serving_model(rng, tmp_path):
+    bst1, X, y = _train(rng)
+    bst2 = lgb.train({**PARAMS, "num_leaves": 7},
+                     lgb.Dataset(X, label=y), num_boost_round=4)
+    path = str(tmp_path / "model.txt")
+    checkpoint.save_checkpoint(bst2, path)  # model text + .ckpt sidecar
+    svc = _service()
+    try:
+        svc.load_model("m", booster=bst1)
+        Q = rng.rand(20, 10)
+        faults.install("model_corrupt_upload")
+        with pytest.raises(ModelLoadError):
+            svc.load_model("m", path=path)
+        # prior version still serving, bit-identical
+        assert svc.registry.get("m").version == 1
+        assert np.array_equal(svc.predict("m", Q), bst1.predict(Q))
+        assert svc.registry.rejected_uploads == 1
+        faults.clear()
+        # the same path loads fine once the transit corruption is gone
+        info = svc.load_model("m", path=path)
+        assert info["version"] == 2 and info["verified"]
+        assert np.array_equal(svc.predict("m", Q), bst2.predict(Q))
+    finally:
+        svc.close()
+
+
+def test_expected_sha256_mismatch_rejected(rng):
+    bst, _, _ = _train(rng)
+    svc = _service()
+    try:
+        text = bst.model_to_string()
+        good = hashlib.sha256(text.encode()).hexdigest()
+        with pytest.raises(ModelLoadError, match="does not match"):
+            svc.load_model("m", model_str=text, expected_sha256="0" * 64)
+        assert svc.registry.names() == []
+        info = svc.load_model("m", model_str=text, expected_sha256=good)
+        assert info["verified"]
+    finally:
+        svc.close()
+
+
+def test_unparseable_model_text_rejected(rng):
+    svc = _service()
+    try:
+        with pytest.raises(ModelLoadError, match="unparseable"):
+            svc.load_model("m", model_str="this is not a model\n")
+        assert svc.registry.names() == []
+    finally:
+        svc.close()
+
+
+def test_damaged_sidecar_rejected_for_serving(rng, tmp_path):
+    bst, _, _ = _train(rng)
+    path = str(tmp_path / "model.txt")
+    checkpoint.save_checkpoint(bst, path)
+    sidecar = path + checkpoint.SIDECAR_SUFFIX
+    blob = bytearray(open(sidecar, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(sidecar, "wb").write(bytes(blob))
+    svc = _service()
+    try:
+        with pytest.raises(ModelLoadError, match="sidecar"):
+            svc.load_model("m", path=path)
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------- breaker
+
+
+def test_breaker_trips_to_host_and_recovers(rng):
+    bst, _, _ = _train(rng)
+    breaker = CircuitBreaker(fail_threshold=3, probe_successes=2,
+                             cooldown_s=0.1)
+    svc = _service(breaker=breaker)
+    try:
+        svc.load_model("m", booster=bst)
+        Q = rng.rand(25, 10)
+        expected = bst.predict(Q)
+        faults.install("predict_fail@1:3")
+        # every response stays correct through the failure window (host
+        # retry in place), and the third failure opens the breaker
+        for _ in range(3):
+            assert np.array_equal(svc.predict("m", Q), expected)
+        assert breaker.state == OPEN
+        faults.clear()
+        # OPEN: served from the host path, still bit-identical
+        assert np.array_equal(svc.predict("m", Q), expected)
+        assert svc.batcher.n_host_chunks >= 4
+        time.sleep(0.15)  # cooldown -> HALF_OPEN probe on next dispatch
+        for _ in range(3):
+            assert np.array_equal(svc.predict("m", Q), expected)
+        assert breaker.state == CLOSED
+        assert breaker.transitions >= 3  # closed->open->half_open->closed
+    finally:
+        svc.close()
+
+
+def test_breaker_probe_failure_reopens():
+    clock = [0.0]
+    b = CircuitBreaker(fail_threshold=1, cooldown_s=5.0,
+                       clock=lambda: clock[0])
+    b.on_failure(RuntimeError("boom"))
+    assert b.state == OPEN
+    assert b.decide().use_host
+    clock[0] = 6.0
+    d = b.decide()
+    assert b.state == HALF_OPEN and d.probe and not d.use_host
+    b.on_failure(RuntimeError("still broken"))
+    assert b.state == OPEN
+    # reopened: cooldown restarts from the probe failure
+    clock[0] = 7.0
+    assert b.decide().use_host
+
+
+def test_breaker_degrades_on_compile_churn_and_recovers():
+    b = CircuitBreaker(compile_churn_limit=4, recovery_successes=2)
+    b.note_signals({"compiles": 10})
+    assert b.state == CLOSED
+    b.note_signals({"compiles": 20})  # +10 >= limit
+    assert b.state == DEGRADED
+    assert b.decide().max_rows == b.degraded_rows
+    b.on_success()
+    b.on_success()
+    assert b.state == CLOSED
+
+
+def test_breaker_degrades_on_hbm_pressure():
+    b = CircuitBreaker(hbm_limit_bytes=1000)
+    b.note_signals({"compiles": 0, "hbm_high_water_bytes": 500})
+    assert b.state == CLOSED
+    b.note_signals({"compiles": 0, "hbm_high_water_bytes": 2000})
+    assert b.state == DEGRADED
+
+
+# ------------------------------------------------- acceptance (end-to-end)
+
+
+def test_fault_injected_serving_scenario(rng, tmp_path):
+    """ISSUE acceptance: slow chunk + corrupt upload + expired deadline +
+    dispatch failures in one serving run — no crash, corrupt model
+    rejected while the prior version serves, breaker trips to host
+    fallback and recovers, every completed response bit-identical."""
+    bst, X, y = _train(rng)
+    breaker = CircuitBreaker(fail_threshold=2, probe_successes=1,
+                             cooldown_s=0.05)
+    svc = _service(breaker=breaker)
+    try:
+        svc.load_model("m", booster=bst)
+        Q = rng.rand(40, 10)
+        expected = bst.predict(Q)
+
+        # slow chunk + an expired deadline riding behind it
+        faults.install("slow_predict@1:0.15")
+        t = threading.Thread(target=lambda: svc.predict("m", Q))
+        t.start()
+        time.sleep(0.02)
+        with pytest.raises(DeadlineExceeded):
+            svc.predict("m", Q, timeout_s=0.03)
+        t.join()
+        faults.clear()
+
+        # corrupt upload rejected mid-flight; v1 keeps serving
+        faults.install("model_corrupt_upload")
+        with pytest.raises(ModelLoadError):
+            svc.load_model("m", model_str=bst.model_to_string(),
+                           expected_sha256=hashlib.sha256(
+                               bst.model_to_string().encode()).hexdigest())
+        faults.clear()
+        assert svc.registry.get("m").version == 1
+        assert np.array_equal(svc.predict("m", Q), expected)
+
+        # sustained dispatch failures: breaker opens, host path serves
+        faults.install("predict_fail@1:2")
+        for _ in range(2):
+            assert np.array_equal(svc.predict("m", Q), expected)
+        assert breaker.state == OPEN
+        faults.clear()
+        assert np.array_equal(svc.predict("m", Q), expected)
+        time.sleep(0.1)
+        assert np.array_equal(svc.predict("m", Q), expected)
+        assert breaker.state == CLOSED
+
+        stats = svc.stats()
+        assert stats["batcher"]["device_failures"] == 2
+        assert stats["batcher"]["host_chunks"] >= 3
+        assert stats["rejected_uploads"] == 1
+        assert svc.healthz()["status"] == "ok"
+    finally:
+        svc.close()
+
+
+def test_close_fails_pending_and_new_requests(rng):
+    bst, _, _ = _train(rng)
+    svc = _service()
+    svc.load_model("m", booster=bst)
+    svc.close()
+    from lightgbm_tpu.serving import ServiceClosed
+
+    with pytest.raises(ServiceClosed):
+        svc.predict("m", rng.rand(2, 10))

@@ -1,0 +1,164 @@
+"""HTTP front suite: every endpoint, typed error statuses, concurrent
+clients, and hot-swap over the wire — all against an ephemeral-port server
+with the stdlib urllib client (no new dependencies on either side).
+"""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import PredictionService
+from lightgbm_tpu.serving.http import serve
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 5}
+
+
+@pytest.fixture(scope="module")
+def served():
+    rng = np.random.RandomState(42)
+    X = rng.rand(500, 10)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float64)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=8)
+    svc = PredictionService(max_batch_rows=1024, batch_window_s=0.0)
+    svc.load_model("m", booster=bst)
+    server, thread = serve(svc, port=0)
+    yield server.port, bst, svc
+    server.shutdown()
+    svc.close()
+
+
+def _call(port, path, payload=None, method=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _call_err(port, path, payload=None, method=None):
+    try:
+        return _call(port, path, payload, method)
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def test_predict_endpoint_bit_identical(served):
+    port, bst, _ = served
+    rng = np.random.RandomState(0)
+    Q = rng.rand(17, 10)
+    status, body = _call(port, "/predict",
+                         {"model": "m", "rows": Q.tolist()})
+    assert status == 200
+    assert body["model"] == "m" and body["version"] == 1
+    assert "latency_ms" in body
+    got = np.asarray(body["predictions"], dtype=np.float32)
+    assert np.array_equal(got, bst.predict(Q).astype(np.float32))
+    status, body = _call(port, "/predict",
+                         {"model": "m", "rows": Q.tolist(),
+                          "raw_score": True})
+    want = bst.predict(Q, raw_score=True).astype(np.float32)
+    assert np.array_equal(
+        np.asarray(body["predictions"], dtype=np.float32), want)
+
+
+def test_error_statuses(served):
+    port, _, _ = served
+    code, body = _call_err(port, "/predict",
+                           {"model": "nope", "rows": [[0.0] * 10]})
+    assert code == 404 and body["error"] == "model_not_found"
+    code, body = _call_err(port, "/predict",
+                           {"model": "m", "rows": [[0.0] * 9]})
+    assert code == 400 and body["error"] == "invalid_request"
+    assert "9 features" in body["detail"]
+    code, body = _call_err(port, "/predict", {"rows": [[0.0] * 10]})
+    assert code == 400 and body["error"] == "invalid_request"
+    code, body = _call_err(port, "/predict", {"model": "m"})
+    assert code == 400 and "rows" in body["detail"]
+    code, body = _call_err(port, "/nowhere")
+    assert code == 404
+    # malformed JSON body
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict", data=b"{not json",
+        method="POST")
+    try:
+        urllib.request.urlopen(req, timeout=10)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 400
+
+
+def test_health_ready_stats_models(served):
+    port, _, _ = served
+    status, body = _call(port, "/healthz")
+    assert status == 200 and body["status"] == "ok"
+    assert body["breaker"]["state"] == "closed"
+    status, body = _call(port, "/readyz")
+    assert status == 200 and body["ready"]
+    status, body = _call(port, "/statz")
+    assert status == 200 and "batcher" in body
+    status, body = _call(port, "/models")
+    assert status == 200
+    assert [m["name"] for m in body["models"]] == ["m"]
+    assert body["models"][0]["n_features"] == 10
+
+
+def test_model_upload_swap_and_unload_over_http(served):
+    port, bst, svc = served
+    rng = np.random.RandomState(1)
+    X = rng.rand(300, 10)
+    y = (X[:, 0] > 0.5).astype(np.float64)
+    other = lgb.train({**PARAMS, "num_leaves": 7},
+                      lgb.Dataset(X, label=y), num_boost_round=4)
+    status, info = _call(port, "/models",
+                         {"name": "other", "model_str":
+                          other.model_to_string()})
+    assert status == 200 and info["version"] == 1
+    Q = rng.rand(9, 10)
+    _, body = _call(port, "/predict", {"model": "other", "rows": Q.tolist()})
+    assert np.array_equal(
+        np.asarray(body["predictions"], np.float32),
+        other.predict(Q).astype(np.float32))
+    # corrupt text never lands; "other" keeps serving v1
+    code, body = _call_err(port, "/models",
+                           {"name": "other", "model_str": "garbage"})
+    assert code == 400 and body["error"] == "model_load_error"
+    assert svc.registry.get("other").version == 1
+    status, body = _call(port, "/models/other", method="DELETE")
+    assert status == 200 and body["unloaded"] == "other"
+    code, body = _call_err(port, "/predict",
+                           {"model": "other", "rows": Q.tolist()})
+    assert code == 404
+
+
+def test_concurrent_http_clients(served):
+    port, bst, _ = served
+    rng = np.random.RandomState(2)
+    queries = [rng.rand(int(n), 10) for n in rng.randint(1, 64, size=12)]
+    expected = [bst.predict(q).astype(np.float32) for q in queries]
+    results = [None] * len(queries)
+    errors = []
+
+    def worker(i):
+        try:
+            _, body = _call(port, "/predict",
+                            {"model": "m", "rows": queries[i].tolist()})
+            results[i] = np.asarray(body["predictions"], np.float32)
+        except Exception as exc:  # pragma: no cover - diagnostic
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(queries))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for got, want in zip(results, expected):
+        assert np.array_equal(got, want)

@@ -1,0 +1,130 @@
+"""Open-loop load test (slow-marked): a fixed-rate arrival process that
+does NOT slow down when the service does — the arrival generator keeps
+firing while an injected slow_predict throttles the worker, so the queue
+genuinely saturates. Asserts the three hardening contracts under
+saturation: queue depth stays bounded (Overloaded/429 instead of growth),
+expired requests are shed without ever reaching a device dispatch
+(telemetry serve_batch row accounting), and every completed response is
+bit-identical to the direct predict.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.serving import (DeadlineExceeded, Overloaded,
+                                  PredictionService)
+from lightgbm_tpu.utils import faults
+
+pytestmark = pytest.mark.slow
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 5}
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.clear()
+
+
+def test_open_loop_saturation_bounded_and_correct(rng, tmp_path):
+    bst = lgb.train(PARAMS, lgb.Dataset(
+        rng.rand(500, 10),
+        label=(rng.rand(500) > 0.5).astype(np.float64)), num_boost_round=8)
+    max_queue_rows = 512
+    rows_per_req = 64
+    # batch = 2 requests, queue = 4 batches deep: a tail-of-queue request
+    # waits several 50ms dispatches, far past its 60ms budget -> shed
+    svc = PredictionService(max_batch_rows=128,
+                            max_queue_rows=max_queue_rows,
+                            batch_window_s=0.0)
+    telemetry.start(str(tmp_path / "tele"), label="serve_load")
+    try:
+        svc.load_model("m", booster=bst)
+        # every dispatch takes >= 50ms while arrivals land every ~2ms with
+        # a 60ms deadline: the service MUST reject and shed to stay bounded
+        faults.install("slow_predict@1:0.05")
+
+        n_requests = 120
+        queries = [rng.rand(rows_per_req, 10) for _ in range(3)]
+        expected = [bst.predict(q) for q in queries]
+        ok, overloaded, deadline = [], [], []
+        peak_queue = [0]
+        lock = threading.Lock()
+
+        def fire(i):
+            q = i % len(queries)
+            try:
+                out = svc.predict("m", queries[q], timeout_s=0.06)
+                with lock:
+                    ok.append((q, out))
+            except Overloaded:
+                with lock:
+                    overloaded.append(i)
+            except DeadlineExceeded:
+                with lock:
+                    deadline.append(i)
+
+        threads = []
+        for i in range(n_requests):
+            t = threading.Thread(target=fire, args=(i,))
+            t.start()
+            threads.append(t)
+            with lock:
+                peak_queue[0] = max(peak_queue[0],
+                                    svc.batcher.stats()["queue_rows"])
+            time.sleep(0.002)  # open loop: fixed arrival rate
+        for t in threads:
+            t.join()
+        faults.clear()
+        # drain: abandoned (caller-timed-out) requests still sitting in the
+        # queue are shed by the worker's next assembly passes
+        t_end = time.monotonic() + 5.0
+        while (svc.batcher.stats()["queue_rows"] > 0
+               and time.monotonic() < t_end):
+            time.sleep(0.02)
+        stats = svc.batcher.stats()
+
+        # 1. bounded admission: depth never exceeded the cap, and the
+        #    saturation produced real Overloaded rejections
+        assert peak_queue[0] <= max_queue_rows
+        assert overloaded, "open-loop saturation never produced a 429"
+        assert stats["queue_rows"] == 0
+        # 2. every arrival accounted for exactly once
+        assert len(ok) + len(overloaded) + len(deadline) == n_requests
+        assert deadline, "60ms deadlines behind 50ms batches never expired"
+        assert stats["deadline_shed"] >= 1
+        # 3. completed responses bit-identical to the direct predict
+        for q, out in ok:
+            assert np.array_equal(out, expected[q])
+    finally:
+        faults.clear()
+        telemetry.stop()
+        svc.close()
+
+    # 4. expired requests never reached the device: every ADMITTED request
+    #    was either dispatched in exactly one serve_batch or shed exactly
+    #    once — so telemetry batch rows + shed rows == admitted rows
+    events_file = None
+    for p in (tmp_path / "tele").rglob("events.jsonl"):
+        events_file = p
+    assert events_file is not None
+    batch_rows = 0
+    batch_requests = 0
+    for line in events_file.read_text().splitlines():
+        ev = json.loads(line)
+        if ev.get("ev") == "serve_batch":
+            batch_rows += int(ev["rows"])
+            batch_requests += int(ev["requests"])
+    admitted = len(ok) + len(deadline)
+    assert batch_rows + stats["deadline_shed"] * rows_per_req \
+        == admitted * rows_per_req
+    assert batch_requests + stats["deadline_shed"] == admitted
+    # shedding really suppressed dispatches: strictly fewer rows hit the
+    # device than were admitted
+    assert batch_rows < admitted * rows_per_req

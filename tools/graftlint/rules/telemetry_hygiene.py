@@ -61,7 +61,7 @@ class TelemetryHygieneRule(Rule):
                    "`if ...enabled...:` guard — payload construction runs "
                    "even with telemetry off (use the counter APIs or guard "
                    "the emission)")
-    scope_prefixes = ("treelearner/", "parallel/")
+    scope_prefixes = ("treelearner/", "parallel/", "serving/")
     scope_exact = ("ops/predict.py",)
 
     def check(self, pkg: Package) -> Iterable[Violation]:

@@ -1,0 +1,148 @@
+"""CI serving smoke: boot the hardened prediction server, drive it over
+HTTP with concurrent clients — including a corrupt upload and a
+deadline-expired request — and assert the service stays healthy and
+bit-exact throughout.
+
+    python tools/serve_smoke.py [telemetry_dir]
+
+Exits nonzero on any violated invariant. When a telemetry dir is given the
+run records a full event stream there (validate it afterwards with
+`python tools/teldiff.py --self-check <dir>`).
+"""
+import json
+import os
+import sys
+import tempfile
+import threading
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _call(port, path, payload=None, method=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def main() -> int:
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import checkpoint, telemetry
+    from lightgbm_tpu.serving import PredictionService
+    from lightgbm_tpu.serving.http import serve
+    from lightgbm_tpu.utils import faults
+
+    tel_dir = sys.argv[1] if len(sys.argv) > 1 else None
+    if tel_dir:
+        telemetry.start(tel_dir, label="serve_smoke")
+
+    rng = np.random.RandomState(42)
+    X = rng.rand(800, 12)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=8)
+
+    with tempfile.TemporaryDirectory() as td:
+        model_path = f"{td}/model.txt"
+        checkpoint.save_checkpoint(bst, model_path)  # text + .ckpt sidecar
+
+        svc = PredictionService(max_batch_rows=1024, batch_window_s=0.001)
+        server, _ = serve(svc, port=0)
+        port = server.port
+        failures = []
+
+        def check(name, ok, detail=""):
+            print(f"  [{'ok' if ok else 'FAIL'}] {name} {detail}")
+            if not ok:
+                failures.append(name)
+
+        # checksum-verified load over HTTP (path + sidecar)
+        status, info = _call(port, "/models",
+                             {"name": "m", "path": model_path})
+        check("verified load", status == 200 and info["verified"]
+              and info["version"] == 1, str(info))
+
+        status, ready = _call(port, "/readyz")
+        check("readyz", status == 200 and ready["ready"])
+
+        # concurrent bit-exact predicts
+        queries = [rng.rand(int(n), 12) for n in rng.randint(1, 128, 16)]
+        expected = [bst.predict(q).astype(np.float32) for q in queries]
+        results = [None] * len(queries)
+
+        def fire(i):
+            s, body = _call(port, "/predict",
+                            {"model": "m", "rows": queries[i].tolist()})
+            if s == 200:
+                results[i] = np.asarray(body["predictions"], np.float32)
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(len(queries))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        exact = all(r is not None and np.array_equal(r, e)
+                    for r, e in zip(results, expected))
+        check("concurrent predicts bit-exact", exact)
+
+        # corrupt upload REJECTED while v1 keeps serving
+        faults.install("model_corrupt_upload")
+        status, body = _call(port, "/models",
+                             {"name": "m", "path": model_path})
+        faults.clear()
+        check("corrupt upload rejected",
+              status == 400 and body.get("error") == "model_load_error")
+        status, body = _call(port, "/predict",
+                             {"model": "m", "rows": queries[0].tolist()})
+        check("prior version still serving", status == 200
+              and body["version"] == 1
+              and np.array_equal(np.asarray(body["predictions"], np.float32),
+                                 expected[0]))
+
+        # deadline-expired request reports 504 without wedging the service
+        faults.install("slow_predict@1:0.3")
+        status, body = _call(port, "/predict",
+                             {"model": "m", "rows": queries[0].tolist(),
+                              "timeout_ms": 40})
+        faults.clear()
+        check("deadline exceeded is 504",
+              status == 504 and body.get("error") == "deadline_exceeded",
+              f"got {status}")
+
+        # typed 400 on a malformed payload, naming the problem
+        status, body = _call(port, "/predict",
+                             {"model": "m", "rows": [[0.0] * 5]})
+        check("typed 400 names feature count", status == 400
+              and "5 features" in body.get("detail", ""))
+
+        # /healthz stays green through all of the above
+        status, health = _call(port, "/healthz")
+        check("healthz green", status == 200
+              and health["status"] == "ok"
+              and health["rejected_uploads"] == 1
+              and health["queue"]["queue_rows"] == 0, str(health)[:200])
+
+        server.shutdown()
+        svc.close()
+
+    if tel_dir:
+        telemetry.stop()
+    if failures:
+        print(f"serve_smoke: FAILED ({', '.join(failures)})")
+        return 1
+    print("serve_smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
