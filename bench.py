@@ -130,16 +130,15 @@ def _wave_traffic_fields(ds) -> dict:
               int(global_timer.counters.get("device_hist_rows", 0))}
     carry = global_timer.counters.get("device_carry_bytes_per_wave")
     if carry is None:
+        from lightgbm_tpu import perfmodel
         from lightgbm_tpu.ops.compact_pallas import COMPACT_TILE
         from lightgbm_tpu.ops.hist_pallas import DEFAULT_TILE_ROWS
 
         core = ds._handle
         unit = max(DEFAULT_TILE_ROWS, COMPACT_TILE)
-        np_rows = -(-core.num_data // unit) * unit
-        g = core.bins.shape[0]
         plane_b = 1 if core.bins.dtype.itemsize == 1 else 4
-        gp = -(-g // 32) * 32 if plane_b == 1 else -(-g // 8) * 8
-        carry = gp * np_rows * plane_b + np_rows * 5 * 4
+        carry = perfmodel.carry_bytes_per_wave(
+            core.num_data, core.bins.shape[0], plane_b, unit)
     fields["est_carried_bytes_per_wave"] = int(carry)
     return fields
 
@@ -179,6 +178,25 @@ def run_bench(n_rows: int) -> dict:
                "rows": n_rows, "iters": N_ITERS,
                "auc": round(_auc(yh, bst.predict(Xh)), 4)}
         out.update(_wave_traffic_fields(ds))
+
+        # cost-model attribution (perfmodel.py): measured per-stage walls
+        # from the timer, the analytic byte model from the published
+        # gauges, and XLA's own cost_analysis() for each captured dispatch
+        # — taken NOW, before the guardrail/telemetry short trains below
+        # pollute the timer totals with their own boosting scopes
+        from lightgbm_tpu import perfmodel
+        from lightgbm_tpu.utils.timer import global_timer
+
+        try:
+            import jax
+
+            devs = jax.devices()
+            kind = str(devs[0].device_kind) if devs else ""
+        except Exception:  # noqa: BLE001 - attribution is best-effort
+            kind = ""
+        out["attribution"] = perfmodel.attribution(
+            dict(global_timer.totals), dict(global_timer.counters),
+            device_kind=kind, include_static=True)
 
         # inference throughput: chunked streaming predict over the train
         # matrix (the serving configuration — double-buffered
@@ -303,6 +321,21 @@ def run_bench(n_rows: int) -> dict:
     return out
 
 
+def _append_ledger(record: dict) -> None:
+    """Append the finished capture to BENCH_LEDGER.jsonl (atomic writer;
+    $BENCH_LEDGER overrides the path or disables with 0/off). Only clean
+    records enter the trail benchdiff gates on — and an append failure
+    must never eat the capture itself."""
+    try:
+        from lightgbm_tpu.fingerprint import append_ledger
+
+        path = append_ledger(record)
+        if path:
+            print(f"# ledger: appended to {path}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - capture output comes first
+        print(f"# ledger: append failed: {e!r}", file=sys.stderr)
+
+
 def main() -> None:
     info = probe_backend()
     if info.get("fallback"):
@@ -316,6 +349,8 @@ def main() -> None:
         except Exception:  # noqa: BLE001 - best-effort override
             pass
 
+    from lightgbm_tpu.fingerprint import fingerprint
+
     record = {
         "metric": "train_row_iters_per_sec",
         "value": 0.0,
@@ -325,6 +360,11 @@ def main() -> None:
         "device": info.get("device"),
         "tpu_fallback_to_cpu": bool(info.get("fallback")),
     }
+    # environment fingerprint: git sha, jax/jaxlib versions, device
+    # kind/count, active LGBM_TPU_* flags + the ledger schema_version —
+    # the provenance benchdiff keys its comparability checks on
+    record["fingerprint"] = fingerprint()
+    record["schema_version"] = record["fingerprint"]["schema_version"]
     if info.get("probe_error"):
         record["probe_error"] = info["probe_error"]
 
@@ -348,9 +388,10 @@ def main() -> None:
                       "guardrail_overhead_pct", "compile_count",
                       "hbm_high_water_bytes", "telemetry_overhead_pct",
                       "serve_rows_per_sec", "serve_p50_ms", "serve_p99_ms",
-                      "serve_batches"):
+                      "serve_batches", "attribution"):
                 if k in res:
                     record[k] = res[k]
+            _append_ledger(record)
             emit(record)
             return
         except Exception as e:  # noqa: BLE001 - degrade, don't crash

@@ -49,10 +49,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .. import perfmodel, telemetry
+
 # Compaction tile: independent of the histogram tile (DEFAULT_TILE_ROWS);
 # the one-hot P is [tile, tile] so smaller tiles keep VMEM + per-pair FLOPs
 # down. N must be padded to a multiple of lcm(COMPACT_TILE, hist tile).
 COMPACT_TILE = 512
+
+# the recompile watcher splits this entry's cache misses into the
+# kernel_compiles counter (kernel-flag experiments show their compile cost)
+telemetry.register_kernel_fn("_pallas_compact_call")
 
 
 def exclusive_cumsum(x: jax.Array) -> jax.Array:
@@ -329,6 +335,13 @@ def compact_rows(bins_p: jax.Array, row_p: jax.Array, dst: jax.Array,
     pair_in, pair_out, is_copy, n_pairs = build_pair_tables(
         dst, class_masks, moved, tile)
     alias = os.environ.get("LGBM_TPU_COMPACT_ALIAS", "") == "1"
-    return _pallas_compact_call(bins_p, row_p.astype(jnp.float32),
-                                dst.astype(jnp.int32), pair_in, pair_out,
+    row_f32 = row_p.astype(jnp.float32)
+    dst_i32 = dst.astype(jnp.int32)
+    if telemetry.enabled():
+        # one-time capture (works at trace time too: tracers carry the
+        # shape/dtype perfmodel's AOT cost_analysis re-lower needs)
+        perfmodel.note_dispatch("compact", _pallas_compact_call,
+                                bins_p, row_f32, dst_i32, pair_in, pair_out,
+                                is_copy, n_pairs, tile, interpret, alias)
+    return _pallas_compact_call(bins_p, row_f32, dst_i32, pair_in, pair_out,
                                 is_copy, n_pairs, tile, interpret, alias)

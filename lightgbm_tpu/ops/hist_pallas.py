@@ -43,6 +43,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .. import telemetry
+
+# classify these entries' jit cache misses as kernel compiles (telemetry's
+# recompile watcher keeps them in a counter separate from XLA churn)
+for _fn in ("pallas_histogram", "pallas_histogram_slots",
+            "pallas_histogram_slots_ragged"):
+    telemetry.register_kernel_fn(_fn)
+
 DEFAULT_TILE_ROWS = 1024  # best of {512, 1024, 2048, 4096} on v5e
 MIN_GROUP_BLOCK = 8  # Mosaic minimum for the second-to-last block dim
 

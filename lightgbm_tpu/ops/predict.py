@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import telemetry
+from .. import perfmodel, telemetry
 from ..common import MISSING_NAN, MISSING_ZERO, K_ZERO_THRESHOLD
 from ..models.tree import Tree
 from ..utils.log import Log
@@ -364,6 +364,10 @@ def predict_raw(packed: PackedEnsemble, X: jax.Array,
             n, T = vals.shape
             return vals.reshape(n, T // num_tree_per_iteration,
                                 num_tree_per_iteration).sum(axis=1)
+        if telemetry.enabled():
+            # one-time dispatch capture for perfmodel's AOT cost_analysis
+            perfmodel.note_dispatch("predict", _predict_raw_fused,
+                                    packed, X, num_tree_per_iteration)
         return _predict_raw_fused(packed, X, num_tree_per_iteration)
 
 

@@ -29,7 +29,11 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from .. import telemetry
 from .predict import PackedEnsemble, forest_level_step
+
+# kernel-compile classification for the recompile watcher's split counter
+telemetry.register_kernel_fn("pallas_predict_raw")
 
 PREDICT_TILE_ROWS = 512
 

@@ -13,6 +13,9 @@ through the batcher's single worker. No framework, no new dependency.
     GET    /healthz          liveness + breaker/queue detail (always 200)
     GET    /readyz           200 once a model is loaded, else 503
     GET    /statz            batcher/breaker/registry counters
+    GET    /metrics          Prometheus text exposition (exposition.py):
+                             telemetry signals + global_timer counters +
+                             the numeric /statz figures as serve_* gauges
 
 Every error is JSON `{"error": <code>, "detail": <msg>}` with the typed
 status from serving/errors.py; Overloaded responses carry Retry-After.
@@ -71,6 +74,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, status: int, body: str, content_type: str) -> None:
+        raw = body.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
     def _send_error(self, exc: Exception) -> None:
         if isinstance(exc, ServingError):
             headers = {"Retry-After": "1"} if isinstance(exc, Overloaded) \
@@ -110,6 +121,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, self.service.stats())
             elif self.path == "/models":
                 self._send_json(200, {"models": self.service.models()})
+            elif self.path == "/metrics":
+                self._metrics()
             else:
                 self._send_json(404, {"error": "not_found",
                                       "detail": self.path})
@@ -167,6 +180,26 @@ class _Handler(BaseHTTPRequestHandler):
             "predictions": preds.tolist(),
             "latency_ms": round((time.monotonic() - t0) * 1000.0, 3),
         })
+
+    def _metrics(self) -> None:
+        from ..exposition import CONTENT_TYPE, render_metrics
+
+        # flatten the numeric /statz figures into serve_* gauges so one
+        # scrape carries the batcher/breaker state next to the telemetry
+        # counter namespace (same names documented in docs/OBSERVABILITY.md)
+        extra: Dict[str, Any] = {}
+
+        def flatten(prefix: str, obj: Any) -> None:
+            if isinstance(obj, dict):
+                for k, v in obj.items():
+                    flatten(f"{prefix}_{k}", v)
+            elif isinstance(obj, bool):
+                extra[prefix] = int(obj)
+            elif isinstance(obj, (int, float)):
+                extra[prefix] = obj
+
+        flatten("serve", self.service.stats())
+        self._send_text(200, render_metrics(extra), CONTENT_TYPE)
 
     def _load_model(self) -> None:
         payload = self._read_json()

@@ -64,6 +64,29 @@ _PXLA_LOGGER = "jax._src.interpreters.pxla"
 
 _session: Optional["TelemetrySession"] = None
 
+# --- kernel-compile classification -----------------------------------------
+# Pallas/Mosaic kernel wrappers register their jitted entry names here at
+# import; the recompile watcher splits their cache misses into the separate
+# `kernel_compiles` counter so kernel-flag experiments (LGBM_TPU_GH_BF16,
+# LGBM_TPU_COMPACT_ALIAS change kernel signatures, hence kernel compiles)
+# show their compile cost apart from ordinary XLA jit churn. The substring
+# markers back up the registry for names we never saw registered.
+_KERNEL_FN_MARKERS = ("pallas", "mosaic")
+_kernel_fns: set = set()
+
+
+def register_kernel_fn(name: str) -> None:
+    """Mark a jitted entry point as a Pallas/Mosaic kernel wrapper (called
+    at import time by ops/hist_pallas.py and friends)."""
+    _kernel_fns.add(str(name))
+
+
+def is_kernel_fn(fn: str) -> bool:
+    if fn in _kernel_fns:
+        return True
+    low = fn.lower()
+    return any(m in low for m in _KERNEL_FN_MARKERS)
+
 
 def enabled() -> bool:
     """True while a session is recording. Hot paths MUST check this before
@@ -92,16 +115,16 @@ def sample_hbm() -> int:
 def signals() -> Dict[str, int]:
     """Cheap watcher snapshot for adaptive consumers — the serving circuit
     breaker polls this between batches to detect compile churn and HBM
-    pressure without owning the watchers. Two ints read from the active
+    pressure without owning the watchers. Ints read from the active
     session (zeros when no session is recording): total jit cache misses
-    seen by the recompile watcher, and the per-device HBM high-water."""
+    seen by the recompile watcher, the Pallas/Mosaic-kernel subset of
+    those, and the per-device HBM high-water. exposition.py renders the
+    same snapshot as Prometheus text."""
     s = _session
     if s is None:
-        return {"compiles": 0, "hbm_high_water_bytes": 0}
-    return {
-        "compiles": s.recompiles.total if s.recompiles is not None else 0,
-        "hbm_high_water_bytes": max(s.hbm.high_water.values(), default=0),
-    }
+        return {"compiles": 0, "kernel_compiles": 0,
+                "hbm_high_water_bytes": 0}
+    return s.signal_snapshot()
 
 
 def resolve_dir(params: Optional[Dict[str, Any]]) -> str:
@@ -154,6 +177,7 @@ class _RecompileWatcher(logging.Handler):
         self._sess = sess
         self.per_key: Counter = Counter()  # (fn, shapes) -> compiles
         self.per_fn: Counter = Counter()
+        self.kernel_total = 0  # Pallas/Mosaic subset of the per_fn total
         self._warned: set = set()
         self._logger = logging.getLogger(_PXLA_LOGGER)
         self._dispatch_logger = logging.getLogger("jax._src.dispatch")
@@ -202,8 +226,12 @@ class _RecompileWatcher(logging.Handler):
         self.per_key[(fn, shapes)] += 1
         self.per_fn[fn] += 1
         global_timer.add_count("jit_compiles", 1)
+        kernel = is_kernel_fn(fn)
+        if kernel:
+            self.kernel_total += 1
+            global_timer.add_count("kernel_compiles", 1)
         self._sess.emit("compile", fn=fn, shapes=shapes[:400],
-                        n_for_fn=self.per_fn[fn])
+                        n_for_fn=self.per_fn[fn], kernel=kernel)
         if (self.per_fn[fn] >= self._sess.recompile_warn
                 and fn not in self._warned):
             self._warned.add(fn)
@@ -330,6 +358,20 @@ class TelemetrySession:
                     out[k] = d
         return out
 
+    def signal_snapshot(self) -> Dict[str, int]:
+        """This session's watcher figures (the signals() payload) — callable
+        even after stop() has already detached the module global, so the
+        close-time metrics.prom snapshot reports the session's real totals
+        instead of the no-session zeros."""
+        return {
+            "compiles": (self.recompiles.total
+                         if self.recompiles is not None else 0),
+            "kernel_compiles": (self.recompiles.kernel_total
+                                if self.recompiles is not None else 0),
+            "hbm_high_water_bytes": max(self.hbm.high_water.values(),
+                                        default=0),
+        }
+
     def close(self) -> Dict[str, Any]:
         if self._closed:
             return self._summary
@@ -342,6 +384,8 @@ class TelemetrySession:
             "n_spans": len(self.spans),
             "compile_count": (self.recompiles.total
                               if self.recompiles is not None else 0),
+            "kernel_compile_count": (self.recompiles.kernel_total
+                                     if self.recompiles is not None else 0),
             "hbm_high_water_bytes": max(self.hbm.high_water.values(),
                                         default=0),
             "timer_totals": {k: round(global_timer.totals[k], 6)
@@ -371,6 +415,15 @@ class TelemetrySession:
         text = "".join(json.dumps(e, sort_keys=True, default=_jsonable) + "\n"
                        for e in self.events)
         atomic_write_text(os.path.join(self.out_dir, EVENTS_FILE), text)
+        # same cadence: a Prometheus textfile snapshot of the live counter
+        # namespace, so a node-exporter collector scrapes a running train
+        # exactly like the serving /metrics endpoint (exposition.py)
+        try:
+            from .exposition import SNAPSHOT_FILE, write_snapshot
+            write_snapshot(os.path.join(self.out_dir, SNAPSHOT_FILE),
+                           signals=self.signal_snapshot())
+        except Exception:  # a scrape failure must never kill a train
+            pass
 
     def _write_trace(self) -> None:
         from .checkpoint import atomic_write_text

@@ -58,6 +58,7 @@ from ..ops.histogram import build_histogram
 from ..ops.split import (SPLIT_FIELDS, ScanMeta, SplitInfo, find_best_split,
                          fix_feature_hist, gather_feature_hist_raw,
                          per_feature_best, reduce_best_record)
+from .. import perfmodel, telemetry
 from ..utils import sanitize
 from ..utils.compat import shard_map
 from ..utils.log import Log
@@ -834,20 +835,33 @@ class DeviceTreeLearner(SerialTreeLearner):
         return n_gh + 2
 
     def _record_carry_bytes(self) -> None:
-        """Gauge: HBM bytes of the per-wave loop carry (bin plane + row
-        payload) — the bandwidth model's dominant term (docs/PERF_NOTES.md).
-        """
+        """Gauges for the analytic bandwidth model (docs/PERF_NOTES.md,
+        executable form in perfmodel.py): HBM bytes of the per-wave loop
+        carry, bytes the ragged histogram kernel streams per row, and the
+        gain-scan read volume per wave — perfmodel.attribution() reads
+        these back to attribute the fused `tree_device` wall."""
+        from .. import perfmodel
         from ..ops.compact_pallas import COMPACT_TILE
         from ..ops.hist_pallas import DEFAULT_TILE_ROWS
         unit = max(DEFAULT_TILE_ROWS, COMPACT_TILE)
-        np_rows = -(-self.num_data // unit) * unit
         G = self.bins_dev.shape[0]
         plane_b = self.bins_dev.dtype.itemsize
         plane_b = plane_b if plane_b == 1 else 4
-        Gp = -(-G // 32) * 32 if plane_b == 1 else -(-G // 8) * 8
         global_timer.set_count(
             "device_carry_bytes_per_wave",
-            Gp * np_rows * plane_b + np_rows * self._payload_cols() * 4)
+            perfmodel.carry_bytes_per_wave(
+                self.num_data, G, plane_b, unit,
+                payload_cols=self._payload_cols()))
+        global_timer.set_count(
+            "device_hist_bytes_per_row",
+            perfmodel.hist_bytes_per_row(G, plane_b))
+        # the replay scan sweeps the [K, G, Bpad, CH] pool block and writes
+        # the [2K, G, REC] best-record store; the pool is 4-byte in both the
+        # float and quantized (int32) regimes
+        global_timer.set_count(
+            "device_scan_bytes_per_wave",
+            perfmodel.scan_bytes_per_wave(self.wave, G,
+                                          self.group_bin_padded))
 
     def train(self, gh_ext: jax.Array,
               bag_indices: Optional[np.ndarray] = None) -> Tree:
@@ -880,6 +894,16 @@ class DeviceTreeLearner(SerialTreeLearner):
         grow = sanitize.guard(
             grow_tree_on_device, (0, 1, 2),
             "grow_tree_on_device (treelearner/device.py train_async)")
+        if telemetry.enabled():
+            # one-time dispatch capture: perfmodel AOT-relowers this exact
+            # signature for cost_analysis() (dict-check no-op afterwards)
+            perfmodel.note_dispatch(
+                "grow_fused", grow_tree_on_device,
+                self.bins_dev, gh, leaf_id0, self.meta, self.tables,
+                self.params_dev, fmask, num_leaves, self.group_bin_padded,
+                cfg.max_depth, quantized=self.quantized,
+                scale_vec=self._scale_vec, batch=self.wave,
+                bagged=bag_indices is not None)
         with global_timer.scope("tree_device"):
             # bins_dev is COPIED per tree: grow_tree_on_device donates its
             # first three args (gh and leaf_id0 are already fresh buffers)

@@ -38,6 +38,7 @@ from ..ops.split import (FeatureMeta, SplitInfo, bins_to_bitset,
                          make_feature_meta)
 from .cegb import CEGB
 from .col_sampler import ColSampler
+from .. import perfmodel, telemetry
 from ..utils.log import Log
 from ..utils.timer import global_timer
 
@@ -300,11 +301,15 @@ class SerialTreeLearner:
         return self._tree_feature_mask
 
     def _search_split(self, state: "_LeafState", leaf: int) -> SplitInfo:
-        rec = find_best_split(
-            self._hist_for_scan(state.hist),
-            jnp.asarray(state.totals, dtype=jnp.float32),
-            self.meta, self.params_dev, self._node_feature_mask(state),
-            self._constraint_of(state), self._penalty_of(state, leaf))
+        args = (self._hist_for_scan(state.hist),
+                jnp.asarray(state.totals, dtype=jnp.float32),
+                self.meta, self.params_dev, self._node_feature_mask(state),
+                self._constraint_of(state), self._penalty_of(state, leaf))
+        if telemetry.enabled():
+            # one-time capture of the gain-scan dispatch signature for
+            # perfmodel's AOT cost_analysis (dict-check no-op afterwards)
+            perfmodel.note_dispatch("scan", find_best_split, *args)
+        rec = find_best_split(*args)
         return SplitInfo.from_packed(np.asarray(rec))
 
     def _constraint_of(self, state: "_LeafState") -> Optional[jax.Array]:

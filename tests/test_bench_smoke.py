@@ -13,7 +13,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.slow
-def test_bench_smoke_cpu():
+def test_bench_smoke_cpu(tmp_path):
+    ledger = tmp_path / "ledger.jsonl"
     env = dict(os.environ)
     env.update({
         "BENCH_ROWS": "20000",
@@ -21,6 +22,7 @@ def test_bench_smoke_cpu():
         "BENCH_PLATFORM": "cpu",  # skip the accelerator probe entirely
         "BENCH_QUANTIZED": "0",   # primary metric only: keep the smoke fast
         "JAX_PLATFORMS": "cpu",
+        "BENCH_LEDGER": str(ledger),  # don't dirty the repo ledger
     })
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -64,3 +66,26 @@ def test_bench_smoke_cpu():
     assert record["serve_p50_ms"] > 0
     assert record["serve_p99_ms"] >= record["serve_p50_ms"]
     assert record["serve_batches"] > 0
+    # provenance: every record carries the environment fingerprint and the
+    # ledger schema version (benchdiff refuses cross-schema comparisons)
+    assert record["schema_version"] == 1
+    fp = record["fingerprint"]
+    assert fp["git_sha"] not in ("", None)
+    assert fp["jax_version"] not in ("unknown", "", None)
+    assert fp["backend"] == "cpu"
+    assert fp["flags"].get("JAX_PLATFORMS") == "cpu"
+    # cost-model attribution: per-stage fractions of the training wall must
+    # close to ~1 (the ISSUE acceptance bound benchdiff also gates on)
+    attr = record["attribution"]
+    assert attr["stages"], attr
+    assert abs(attr["fractions_sum"] - 1.0) <= 0.05, attr
+    assert all(s["wall_s"] >= 0 for s in attr["stages"].values())
+    # XLA static cost analysis captured for the instrumented dispatches
+    static = attr.get("static") or {}
+    assert "scan" in static and "predict" in static, sorted(static)
+    assert static["scan"].get("flops", 0) > 0, static["scan"]
+    # the same record was appended to the ledger (atomic rewrite path)
+    led = [json.loads(ln) for ln in
+           ledger.read_text().splitlines() if ln.strip()]
+    assert len(led) == 1
+    assert led[0]["value"] == record["value"]

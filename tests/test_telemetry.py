@@ -208,6 +208,37 @@ def test_recompile_watcher_restores_logging_state():
     assert bool(jax.config.jax_log_compiles) == prev_flag
 
 
+def test_kernel_fn_registry_and_markers():
+    telemetry.register_kernel_fn("my_custom_kernel_entry")
+    assert telemetry.is_kernel_fn("my_custom_kernel_entry")
+    # the pallas wrappers register at import; substring markers back them up
+    assert telemetry.is_kernel_fn("_pallas_compact_call")
+    assert telemetry.is_kernel_fn("some_mosaic_lowered_fn")
+    assert not telemetry.is_kernel_fn("find_best_split")
+
+
+def test_recompile_watcher_splits_kernel_compiles():
+    pxla = logging.getLogger("jax._src.interpreters.pxla")
+    with telemetry.capture(None, label="kernel") as s:
+        base = telemetry.signals()
+        # synthetic compile-log lines in jax's exact format: one Pallas
+        # kernel wrapper, one ordinary jit function
+        pxla.warning("Compiling pallas_histogram with global shapes and "
+                     "types (f32[128,8],). Argument mapping: ().")
+        pxla.warning("Compiling update_score with global shapes and "
+                     "types (f32[128],). Argument mapping: ().")
+        sig = telemetry.signals()
+        assert sig["compiles"] == base["compiles"] + 2
+        assert sig["kernel_compiles"] == base["kernel_compiles"] + 1
+        flags = {e["fn"]: e["kernel"] for e in s.events
+                 if e["ev"] == "compile"
+                 and e["fn"] in ("pallas_histogram", "update_score")}
+        assert flags == {"pallas_histogram": True, "update_score": False}
+    summary = s.close()
+    assert summary["kernel_compile_count"] == 1
+    assert summary["compile_count"] >= 2
+
+
 class _FakeDevice:
     def __init__(self, name, peak):
         self._name, self._peak = name, peak

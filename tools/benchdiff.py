@@ -1,0 +1,271 @@
+#!/usr/bin/env python3
+"""benchdiff: diff bench records / gate a PR on the bench ledger.
+
+bench.py appends every capture to BENCH_LEDGER.jsonl (one fingerprinted
+JSON record per line — see lightgbm_tpu/fingerprint.py and the schema
+section of docs/OBSERVABILITY.md). This tool makes a regression visible
+at PR time:
+
+    python tools/benchdiff.py OLD.json NEW.json        # two record files
+    python tools/benchdiff.py BENCH_LEDGER.jsonl       # newest vs previous
+    python tools/benchdiff.py LEDGER --gate            # exit 1 on regression
+    python tools/benchdiff.py LEDGER --gate --baseline BENCH_BASELINE_CPU.json
+    python tools/benchdiff.py LEDGER --gate --deterministic-only   # CI mode
+
+Per-metric DIRECTION and threshold live in the SPEC table: a 10% drop in
+row-iters/s is a regression, a 10% drop in serve_p99_ms is an
+improvement — symmetric gating (tools/teldiff.py's old behaviour) cannot
+express that. Metrics are split into two classes:
+
+  * deterministic — structure the code fully determines (auc on the fixed
+    bench seed, est_carried_bytes_per_wave, predict_chunk_rows,
+    device_hist_rows, attribution sanity). Gated everywhere, including CI
+    runners whose absolute speed means nothing.
+  * perf — wall-clock-derived (throughputs, latencies, compile counts).
+    Gated by default, skipped under --deterministic-only (CI compares a
+    GitHub runner against a committed baseline from a different machine:
+    timing comparisons there are noise, not signal).
+
+Records are only comparable when rows/iters/platform and the ledger
+schema version match; non-comparable pairs skip the affected metrics
+with a note (or fail under --strict). stdlib only — runs anywhere.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+
+class Metric(NamedTuple):
+    direction: str       # "higher" | "lower" | "exact"
+    rel_tol: float       # allowed regression as a fraction (0.10 = 10%)
+    cls: str             # "deterministic" | "perf"
+    abs_tol: float = 0.0  # absolute slack, for near-zero metrics (auc)
+
+
+SPEC: Dict[str, Metric] = {
+    # --- perf: wall-clock-derived, generous thresholds over host noise ----
+    "value": Metric("higher", 0.10, "perf"),
+    "quantized_row_iters_per_sec": Metric("higher", 0.15, "perf"),
+    "predict_rows_per_sec": Metric("higher", 0.15, "perf"),
+    "serve_rows_per_sec": Metric("higher", 0.25, "perf"),
+    "serve_p50_ms": Metric("lower", 0.50, "perf"),
+    "serve_p99_ms": Metric("lower", 1.00, "perf"),
+    "checkpoint_write_ms": Metric("lower", 1.00, "perf"),
+    # compile counts vary with micro-batch bucket warming order, so they
+    # gate as perf despite not being wall-clock
+    "compile_count": Metric("lower", 0.25, "perf"),
+    "hbm_high_water_bytes": Metric("lower", 0.10, "perf"),
+    # --- deterministic: the code fully determines these on the bench seed -
+    "auc": Metric("higher", 0.0, "deterministic", abs_tol=0.02),
+    "quantized_auc": Metric("higher", 0.0, "deterministic", abs_tol=0.02),
+    "est_carried_bytes_per_wave": Metric("exact", 0.0, "deterministic"),
+    "predict_chunk_rows": Metric("exact", 0.0, "deterministic"),
+    "device_hist_rows": Metric("exact", 0.0, "deterministic"),
+}
+
+# fields that must MATCH for two records to be comparable at all
+COMPARABILITY_KEYS = ("rows", "iters", "platform")
+
+# attribution sanity gate: ISSUE acceptance — fractions sum to 1 +/- this
+FRACTIONS_TOL = 0.05
+
+
+class Finding(NamedTuple):
+    metric: str
+    kind: str           # "regression" | "improvement" | "note" | "skip"
+    detail: str
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    """A .jsonl ledger (all lines) or a single-record .json file."""
+    recs: List[Dict[str, Any]] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read().strip()
+    if not text:
+        return recs
+    if path.endswith(".jsonl"):
+        for i, line in enumerate(text.splitlines()):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except ValueError as e:
+                raise SystemExit(f"{path}:{i + 1}: bad ledger line: {e}")
+        return recs
+    obj = json.loads(text)
+    if isinstance(obj, list):
+        recs.extend(obj)
+    else:
+        recs.append(obj)
+    return recs
+
+
+def _schema_of(rec: Dict[str, Any]) -> int:
+    v = rec.get("schema_version",
+                (rec.get("fingerprint") or {}).get("schema_version", 0))
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return 0
+
+
+def comparable(old: Dict[str, Any], new: Dict[str, Any]
+               ) -> Tuple[bool, List[str]]:
+    problems: List[str] = []
+    so, sn = _schema_of(old), _schema_of(new)
+    if so != sn:
+        problems.append(f"schema_version {so} vs {sn}")
+    for key in COMPARABILITY_KEYS:
+        if old.get(key) != new.get(key):
+            problems.append(f"{key} {old.get(key)!r} vs {new.get(key)!r}")
+    return not problems, problems
+
+
+def diff(old: Dict[str, Any], new: Dict[str, Any],
+         deterministic_only: bool = False,
+         threshold_scale: float = 1.0) -> List[Finding]:
+    """Compare two records metric by metric under SPEC. threshold_scale
+    multiplies every relative tolerance (--threshold 2 doubles the slack
+    on a known-noisy host)."""
+    findings: List[Finding] = []
+    ok, problems = comparable(old, new)
+    if not ok:
+        findings.append(Finding("comparability", "skip",
+                                "records not comparable: "
+                                + "; ".join(problems)))
+        return findings
+    for name, spec in SPEC.items():
+        if deterministic_only and spec.cls != "deterministic":
+            continue
+        if name not in old or name not in new:
+            continue
+        try:
+            ov, nv = float(old[name]), float(new[name])
+        except (TypeError, ValueError):
+            continue
+        findings.extend(_judge(name, spec, ov, nv, threshold_scale))
+    findings.extend(_attribution_checks(new))
+    return findings
+
+
+def _judge(name: str, spec: Metric, ov: float, nv: float,
+           scale: float) -> List[Finding]:
+    rel = spec.rel_tol * scale
+    if spec.direction == "exact":
+        if nv != ov:
+            return [Finding(name, "regression",
+                            f"{ov:g} -> {nv:g} (exact-match metric changed)")]
+        return []
+    # signed change in the GOOD direction (positive = better)
+    good = (nv - ov) if spec.direction == "higher" else (ov - nv)
+    base = abs(ov) if ov else 1.0
+    slack = base * rel + spec.abs_tol
+    pct = 100.0 * (nv - ov) / base if base else 0.0
+    detail = f"{ov:g} -> {nv:g} ({pct:+.1f}%, {spec.direction}-is-better)"
+    if good < -slack:
+        return [Finding(name, "regression", detail)]
+    if good > slack:
+        return [Finding(name, "improvement", detail)]
+    return [Finding(name, "note", detail + " within threshold")]
+
+
+def _attribution_checks(new: Dict[str, Any]) -> List[Finding]:
+    """Structural sanity of the new record's attribution block (present
+    since schema v1): stage fractions must sum to ~1."""
+    attr = new.get("attribution")
+    if not isinstance(attr, dict):
+        return []
+    fsum = attr.get("fractions_sum")
+    if fsum is None:
+        return [Finding("attribution", "regression",
+                        "attribution block has no fractions_sum")]
+    if abs(float(fsum) - 1.0) > FRACTIONS_TOL:
+        return [Finding("attribution", "regression",
+                        f"stage fractions sum to {fsum} "
+                        f"(expected 1 +/- {FRACTIONS_TOL})")]
+    return [Finding("attribution", "note",
+                    f"fractions_sum {fsum} within 1 +/- {FRACTIONS_TOL}")]
+
+
+def render(old: Dict[str, Any], new: Dict[str, Any],
+           findings: List[Finding]) -> str:
+    lines = []
+    ofp = old.get("fingerprint") or {}
+    nfp = new.get("fingerprint") or {}
+    lines.append(f"benchdiff: {ofp.get('git_sha', '?')} -> "
+                 f"{nfp.get('git_sha', '?')}  "
+                 f"(platform {new.get('platform', '?')}, "
+                 f"rows {new.get('rows', '?')}, iters {new.get('iters', '?')})")
+    order = {"regression": 0, "improvement": 1, "skip": 2, "note": 3}
+    for f in sorted(findings, key=lambda f: (order.get(f.kind, 9), f.metric)):
+        tag = {"regression": "REGRESSION", "improvement": "improved",
+               "skip": "skipped", "note": "ok"}.get(f.kind, f.kind)
+        lines.append(f"  [{tag:>10}] {f.metric}: {f.detail}")
+    n_reg = sum(1 for f in findings if f.kind == "regression")
+    lines.append(f"benchdiff: {n_reg} regression(s), "
+                 f"{sum(1 for f in findings if f.kind == 'improvement')} "
+                 f"improvement(s)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff bench records / gate on the bench ledger")
+    ap.add_argument("paths", nargs="+",
+                    help="LEDGER.jsonl, or two record files OLD NEW")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 when any regression is found")
+    ap.add_argument("--baseline",
+                    help="record file to diff the ledger head against "
+                         "(default: the ledger's previous record)")
+    ap.add_argument("--deterministic-only", action="store_true",
+                    help="gate only code-determined metrics (CI mode: "
+                         "skip wall-clock metrics across hosts)")
+    ap.add_argument("--threshold", type=float, default=1.0,
+                    help="scale every relative tolerance (2 = double slack)")
+    ap.add_argument("--strict", action="store_true",
+                    help="treat non-comparable records as a gate failure")
+    args = ap.parse_args(argv)
+
+    if len(args.paths) == 2 and args.baseline is None:
+        old = load_records(args.paths[0])[-1]
+        new = load_records(args.paths[1])[-1]
+    elif len(args.paths) == 1:
+        ledger = load_records(args.paths[0])
+        if not ledger:
+            print(f"benchdiff: {args.paths[0]} is empty", file=sys.stderr)
+            return 1 if args.gate else 0
+        new = ledger[-1]
+        if args.baseline:
+            old = load_records(args.baseline)[-1]
+        elif len(ledger) >= 2:
+            old = ledger[-2]
+        else:
+            print("benchdiff: single record and no --baseline; "
+                  "nothing to diff")
+            return 0
+    else:
+        ap.error("pass LEDGER.jsonl, or OLD NEW record files")
+        return 2  # unreachable; argparse exits
+
+    if "error" in new:
+        print(f"benchdiff: newest record is a failure record: "
+              f"{new['error']}", file=sys.stderr)
+        return 1 if args.gate else 0
+
+    findings = diff(old, new, deterministic_only=args.deterministic_only,
+                    threshold_scale=args.threshold)
+    print(render(old, new, findings))
+    regressed = any(f.kind == "regression" for f in findings)
+    skipped = any(f.kind == "skip" for f in findings)
+    if args.gate and (regressed or (args.strict and skipped)):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

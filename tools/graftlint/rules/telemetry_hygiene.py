@@ -62,7 +62,10 @@ class TelemetryHygieneRule(Rule):
                    "even with telemetry off (use the counter APIs or guard "
                    "the emission)")
     scope_prefixes = ("treelearner/", "parallel/", "serving/")
-    scope_exact = ("ops/predict.py",)
+    # perfmodel/exposition sit on the scrape path: a /metrics render or a
+    # per-dispatch capture hook runs with telemetry off too, so unguarded
+    # emits there cost every caller, not just telemetry users
+    scope_exact = ("ops/predict.py", "perfmodel.py", "exposition.py")
 
     def check(self, pkg: Package) -> Iterable[Violation]:
         out: List[Violation] = []

@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""perfreport: human view of a bench record's cost-model attribution.
+
+bench.py embeds the perfmodel.attribution() report (per-stage measured
+wall, fraction of the training wall, analytic model bytes, model-implied
+seconds at peak bandwidth, measured-vs-model drift, roofline fraction,
+and XLA's static cost_analysis per captured dispatch) in every capture
+record. This renders it as a table:
+
+    python tools/perfreport.py BENCH_LEDGER.jsonl      # newest record
+    python tools/perfreport.py record.json
+    python tools/perfreport.py BENCH_LEDGER.jsonl --index -2
+
+stdlib only.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _load(path: str) -> List[Dict[str, Any]]:
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read().strip()
+    if path.endswith(".jsonl"):
+        return [json.loads(ln) for ln in text.splitlines() if ln.strip()]
+    obj = json.loads(text)
+    return obj if isinstance(obj, list) else [obj]
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:,.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def render(record: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    fp = record.get("fingerprint") or {}
+    lines.append(
+        f"perfreport: {record.get('metric', '?')} = {record.get('value', '?')}"
+        f" {record.get('unit', '')}  (sha {fp.get('git_sha', '?')}, "
+        f"{record.get('platform', '?')}/{fp.get('device_kind', '?')}, "
+        f"rows {record.get('rows', '?')}, iters {record.get('iters', '?')})")
+    attr = record.get("attribution")
+    if not isinstance(attr, dict):
+        lines.append("  no attribution block in this record "
+                     "(pre-schema-v1 capture?)")
+        return "\n".join(lines)
+    lines.append(f"  training wall {attr.get('total_s', '?')}s, "
+                 f"stage-covered {attr.get('covered_s', '?')}s, "
+                 f"fractions_sum {attr.get('fractions_sum', '?')}")
+    bw = attr.get("peak_bw_bytes_per_s")
+    if bw:
+        lines.append(f"  roofline bandwidth {_fmt_bytes(bw)}/s "
+                     "(LGBM_TPU_PEAK_BW_GBPS to calibrate)")
+    header = (f"  {'stage':<14}{'wall_s':>10}{'frac':>8}{'model':>12}"
+              f"{'model_s':>10}{'drift':>9}{'roofline':>10}")
+    lines.append(header)
+    lines.append("  " + "-" * (len(header) - 2))
+    stages = attr.get("stages", {})
+    for name, st in sorted(stages.items(),
+                           key=lambda kv: -kv[1].get("wall_s", 0.0)):
+        model_s = (f"{st['model_s']:>10.4f}" if "model_s" in st
+                   else f"{'-':>10}")
+        drift = (f"{st['drift_pct']:>+8.1f}%" if "drift_pct" in st
+                 else f"{'-':>9}")
+        roof = (f"{st['roofline_frac']:>10.1%}" if "roofline_frac" in st
+                else f"{'-':>10}")
+        lines.append(f"  {name:<14}"
+                     f"{st.get('wall_s', 0.0):>10.4f}"
+                     f"{st.get('fraction', 0.0):>8.1%}"
+                     f"{_fmt_bytes(st.get('model_bytes')):>12}"
+                     f"{model_s}{drift}{roof}")
+        comp = st.get("model_components_bytes")
+        if comp:
+            inner = ", ".join(f"{k}={_fmt_bytes(v)}"
+                              for k, v in sorted(comp.items()))
+            lines.append(f"    model components: {inner}")
+    static = attr.get("static")
+    if static:
+        lines.append("  static cost_analysis (per captured dispatch):")
+        for stage, entry in sorted(static.items()):
+            if "error" in entry:
+                lines.append(f"    {stage:<12} unavailable: {entry['error']}")
+                continue
+            lines.append(
+                f"    {stage:<12} flops={entry.get('flops', 0):,.0f}  "
+                f"bytes={_fmt_bytes(entry.get('bytes_accessed'))}  "
+                f"args={_fmt_bytes(entry.get('argument_bytes'))}  "
+                f"out={_fmt_bytes(entry.get('output_bytes'))}  "
+                f"temp={_fmt_bytes(entry.get('temp_bytes'))}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render a bench record's cost-model attribution")
+    ap.add_argument("path", help="BENCH_LEDGER.jsonl or a record .json")
+    ap.add_argument("--index", type=int, default=-1,
+                    help="which ledger record (default -1 = newest)")
+    args = ap.parse_args(argv)
+    records = _load(args.path)
+    if not records:
+        print(f"perfreport: {args.path} is empty", file=sys.stderr)
+        return 1
+    print(render(records[args.index]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

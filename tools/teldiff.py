@@ -17,7 +17,12 @@ Usage:
 
 `diff` prints per-label time and counter deltas and exits nonzero when any
 tracked figure regresses by more than --threshold percent (default 10) —
-the machine check "bench before/after" needs. `--self-check` validates a
+the machine check "bench before/after" needs. Gating is direction-aware
+(COUNTER_DIRECTIONS): time and byte figures regress UPWARD, while counters
+like committed splits or predict-cache hits regress DOWNWARD — a symmetric
+threshold cannot tell an optimization from a regression. `summarize` also
+prints per-label span-duration p50/p99 recovered from trace.json.
+`--self-check` validates a
 run's artifacts (parseable JSONL, required event types, monotonic trace
 timestamps, matched B/E span pairs) and exits nonzero on any violation —
 CI runs it on the smoke-train artifact.
@@ -32,17 +37,26 @@ from typing import Any, Dict, List, Optional, Tuple
 
 EVENTS_FILE = "events.jsonl"
 TRACE_FILE = "trace.json"
-# counters where a higher value is a regression (time-like figures always
-# regress upward); everything else is reported but never gates the exit code
-REGRESSION_COUNTERS = (
-    "jit_compiles",
-    "hbm_high_water_bytes",
-    "device_hist_rows",
-    "device_ici_bytes_per_wave",
-    "device_carry_bytes_per_wave",
-    "wave_splits_speculated",
-    "device_waves",
-)
+# per-counter DIRECTION for --threshold gating: "lower" means a higher
+# value is a regression (bytes moved, compiles, speculation waste);
+# "higher" means a DROP is the regression (work the optimizer is supposed
+# to keep, e.g. committed splits or predict-cache hits falling means the
+# fast path stopped engaging). Counters not listed are reported but never
+# gate the exit code.
+COUNTER_DIRECTIONS: Dict[str, str] = {
+    "jit_compiles": "lower",
+    "kernel_compiles": "lower",
+    "hbm_high_water_bytes": "lower",
+    "device_hist_rows": "lower",
+    "device_ici_bytes_per_wave": "lower",
+    "device_carry_bytes_per_wave": "lower",
+    "device_scan_bytes_per_wave": "lower",
+    "device_hist_bytes_per_row": "lower",
+    "wave_splits_speculated": "lower",
+    "device_waves": "lower",
+    "wave_splits_committed": "higher",
+    "predict_pack_hits": "higher",
+}
 
 
 def _read_events(run_dir: str) -> List[Dict[str, Any]]:
@@ -79,6 +93,59 @@ def _fmt_bytes(n: float) -> str:
     return f"{n:.1f} GiB"
 
 
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(
+        q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _span_durations(run_dir: str) -> Dict[str, List[float]]:
+    """Per-label span durations (ms) from trace.json's B/E pairs. Labels
+    never self-nest (one tid per label — telemetry.build_chrome_trace), so
+    a simple per-track open-stack recovers every duration."""
+    path = os.path.join(run_dir, TRACE_FILE)
+    if not os.path.isfile(path):
+        return {}
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            trace = json.load(fh)
+    except json.JSONDecodeError:
+        return {}
+    open_ts: Dict[Tuple[int, int], List[int]] = {}
+    durations: Dict[str, List[float]] = {}
+    for ev in trace.get("traceEvents", []):
+        ph = ev.get("ph")
+        if ph not in ("B", "E"):
+            continue
+        key = (ev.get("pid", 0), ev.get("tid", 0))
+        if ph == "B":
+            open_ts.setdefault(key, []).append(int(ev.get("ts", 0)))
+        else:
+            stack = open_ts.get(key)
+            if stack:
+                t0 = stack.pop()
+                durations.setdefault(str(ev.get("name", "?")), []).append(
+                    (int(ev.get("ts", 0)) - t0) / 1000.0)
+    return durations
+
+
+def _print_span_percentiles(run_dir: str) -> None:
+    durations = _span_durations(run_dir)
+    if not durations:
+        return
+    print("span durations (ms):")
+    print(f"  {'label':<24} {'n':>6} {'p50':>10} {'p99':>10} {'max':>10}")
+    for label in sorted(durations,
+                        key=lambda k: -sum(durations[k])):
+        vals = sorted(durations[label])
+        print(f"  {label:<24} {len(vals):>6} "
+              f"{_percentile(vals, 50):>10.3f} "
+              f"{_percentile(vals, 99):>10.3f} {vals[-1]:>10.3f}")
+
+
 def summarize(run_dir: str) -> int:
     events = _read_events(run_dir)
     end = _session_end(events)
@@ -109,6 +176,7 @@ def summarize(run_dir: str) -> int:
         mid = walls[len(walls) // 2]
         print(f"per-iteration wall: median {mid:.4f}s  "
               f"min {walls[0]:.4f}s  max {walls[-1]:.4f}s")
+    _print_span_percentiles(run_dir)
     return 0
 
 
@@ -124,7 +192,9 @@ def diff(base_dir: str, cand_dir: str, threshold: float) -> int:
     regressions: List[str] = []
 
     def _section(name: str, b: Dict[str, Any], c: Dict[str, Any],
-                 gate: Tuple[str, ...], unit: str) -> None:
+                 directions: Any, unit: str) -> None:
+        # directions: "lower" applied to every key, or a per-key map —
+        # a +15% in committed splits must not gate like +15% in bytes
         keys = sorted(set(b) | set(c))
         if not keys:
             return
@@ -134,15 +204,22 @@ def diff(base_dir: str, cand_dir: str, threshold: float) -> int:
             p = _pct(bv, cv)
             ptxt = "   (new)" if p == float("inf") else (
                 "" if p is None else f" {p:+8.1f}%")
-            print(f"  {k:<32} {bv:>12g} -> {cv:>12g}{unit}{ptxt}")
-            gated = gate == ("*",) or k in gate
-            if gated and p is not None and p > threshold:
-                regressions.append(f"{k}: {bv:g} -> {cv:g} ({p:+.1f}%)")
+            direction = directions if isinstance(directions, str) \
+                else directions.get(k)
+            dtxt = f"  [{direction}-is-better]" if direction else ""
+            print(f"  {k:<32} {bv:>12g} -> {cv:>12g}{unit}{ptxt}{dtxt}")
+            if direction is None or p is None:
+                continue
+            bad_pct = p if direction == "lower" else -p
+            if bad_pct > threshold:
+                regressions.append(
+                    f"{k}: {bv:g} -> {cv:g} ({p:+.1f}%, "
+                    f"{direction}-is-better)")
 
     _section("timer totals (s)", base.get("timer_totals", {}),
-             cand.get("timer_totals", {}), ("*",), "s")
+             cand.get("timer_totals", {}), "lower", "s")
     _section("counters", base.get("counters", {}),
-             cand.get("counters", {}), REGRESSION_COUNTERS, "")
+             cand.get("counters", {}), COUNTER_DIRECTIONS, "")
     for scalar in ("compile_count", "hbm_high_water_bytes", "duration_s"):
         bv, cv = float(base.get(scalar, 0)), float(cand.get(scalar, 0))
         if bv or cv:
