@@ -212,23 +212,43 @@ def run_bench(n_rows: int) -> dict:
         out["predict_chunk_rows"] = pred_chunk
 
         # serving-layer throughput: an open-loop generator firing fixed-size
-        # requests at the hardened prediction service (docs/SERVING.md) —
-        # micro-batched into the power-of-two buckets warmed at load
+        # requests over HTTP at the hardened prediction service
+        # (docs/SERVING.md) — the full request path, so the tracing stage
+        # histograms decompose the serve-vs-direct gap into named numbers
+        # (parse / queue_wait / assembly / device / d2h / serialize)
+        import json as json_mod
         import threading
+        import urllib.request
 
+        from lightgbm_tpu import tracing
         from lightgbm_tpu.serving import PredictionService
+        from lightgbm_tpu.serving.http import serve as serve_http
 
         serve_rows = 64
         serve_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", 300))
+        tracing.reset_stats()  # this section owns the stage quantiles
         svc = PredictionService(max_batch_rows=4096, batch_window_s=0.001)
+        server = None
         try:
             svc.load_model("bench", booster=bst)
+            server, _ = serve_http(svc, port=0)
+            url = f"http://127.0.0.1:{server.port}/predict"
             span = max(X.shape[0] - serve_rows, 1)
+            # request bodies built outside the timed loop: client-side
+            # encoding is the generator's cost, not the service's
+            bodies = [json_mod.dumps(
+                {"model": "bench", "raw_score": True,
+                 "rows": X[(i * serve_rows) % span:
+                           (i * serve_rows) % span + serve_rows].tolist()}
+            ).encode() for i in range(serve_requests)]
             served = []
 
             def fire(i):
-                lo = (i * serve_rows) % span
-                svc.predict("bench", X[lo:lo + serve_rows], raw_score=True)
+                req = urllib.request.Request(
+                    url, data=bodies[i],
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    resp.read()
                 served.append(i)
 
             t0 = time.perf_counter()
@@ -247,7 +267,18 @@ def run_bench(n_rows: int) -> dict:
             out["serve_p50_ms"] = round(sstats.get("p50_ms", 0.0), 3)
             out["serve_p99_ms"] = round(sstats.get("p99_ms", 0.0), 3)
             out["serve_batches"] = int(sstats["batches"])
+            stages = svc.stats().get("stages", {})
+            for stage, field in (("parse", "serve_parse_ms_p99"),
+                                 ("queue_wait", "serve_queue_ms_p99"),
+                                 ("assembly", "serve_assembly_ms_p99"),
+                                 ("device", "serve_device_ms_p99"),
+                                 ("d2h", "serve_d2h_ms_p99"),
+                                 ("serialize", "serve_serialize_ms_p99")):
+                out[field] = round(
+                    stages.get(stage, {}).get("p99_ms", 0.0), 3)
         finally:
+            if server is not None:
+                server.shutdown()
             svc.close()
 
         # robustness-layer cost: one full-state checkpoint write of the
@@ -388,7 +419,10 @@ def main() -> None:
                       "guardrail_overhead_pct", "compile_count",
                       "hbm_high_water_bytes", "telemetry_overhead_pct",
                       "serve_rows_per_sec", "serve_p50_ms", "serve_p99_ms",
-                      "serve_batches", "attribution"):
+                      "serve_batches", "serve_parse_ms_p99",
+                      "serve_queue_ms_p99", "serve_assembly_ms_p99",
+                      "serve_device_ms_p99", "serve_d2h_ms_p99",
+                      "serve_serialize_ms_p99", "attribution"):
                 if k in res:
                     record[k] = res[k]
             _append_ledger(record)

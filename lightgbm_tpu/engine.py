@@ -15,7 +15,7 @@ import numpy as np
 
 from . import callback as callback_mod
 from . import checkpoint as checkpoint_mod
-from . import telemetry
+from . import telemetry, tracing
 from .basic import Booster, Dataset
 from .callback import CallbackEnv, EarlyStopException
 from .config import key_alias_transform
@@ -62,6 +62,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
         return _train_impl(params, train_set, num_boost_round, valid_sets,
                            valid_names, feval, init_model,
                            keep_training_booster, callbacks)
+    except Exception as exc:
+        # black box for the postmortem: whatever the ring saw right up to
+        # the unhandled failure (recorder works with telemetry off too)
+        tracing.note("train_exception", error=repr(exc)[:400])
+        tracing.dump_flight("train_exception")
+        raise
     finally:
         if own_tel is not None:
             telemetry.stop()
@@ -193,6 +199,10 @@ def _train_loop_inner(booster, params, feval, fobj, init_iteration,
         if is_finished:
             break
         it_t0 = time.perf_counter()
+        # iteration span: same API as the serving request spans, so the
+        # Chrome-trace export and the flight recorder speak one format
+        it_span = tracing.start_span("train_iteration")
+        it_span.attrs["iteration"] = int(i)
         counters_before = (dict(global_timer.counters)
                            if telemetry.enabled() else None)
         for cb in callbacks_before:
@@ -201,6 +211,8 @@ def _train_loop_inner(booster, params, feval, fobj, init_iteration,
                            end_iteration=init_iteration + num_boost_round,
                            evaluation_result_list=None))
         is_finished = booster.update(fobj=fobj)
+        t_boost_end = time.perf_counter()
+        it_span.add_stage("boost", t_boost_end - it_t0)
 
         evaluation_result_list = []
         if booster._gbdt.valid_sets or booster._gbdt.train_metrics:
@@ -217,6 +229,8 @@ def _train_loop_inner(booster, params, feval, fobj, init_iteration,
             booster.best_iteration = earlyStopException.best_iteration + 1
             evaluation_result_list = earlyStopException.best_score
             is_finished = True
+        it_span.add_stage("eval", time.perf_counter() - t_boost_end)
+        it_span.finish()
         if counters_before is not None:
             _emit_iteration_record(booster, i, evaluation_result_list,
                                    time.perf_counter() - it_t0,

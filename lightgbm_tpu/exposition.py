@@ -30,7 +30,7 @@ from __future__ import annotations
 import re
 from typing import Any, Dict, List, Mapping, Optional, Tuple
 
-from . import telemetry
+from . import telemetry, tracing
 from .utils.timer import global_timer
 
 PREFIX = "lgbm_tpu"
@@ -119,6 +119,11 @@ def render_metrics(extra: Optional[Mapping[str, Any]] = None,
         else:
             w.sample(_metric_name(key, "_total"), "counter", value,
                      "work counter from the global_timer counter namespace")
+    # request/iteration stage quantiles from the tracing histograms
+    # (log-bucketed streaming p50/p99 — serving's 25× decomposition)
+    for key, value in sorted(tracing.quantile_gauges().items()):
+        w.sample(_metric_name(key), "gauge", value,
+                 "stage latency quantile from the tracing histograms (ms)")
     sec_name = f"{PREFIX}_stage_seconds_total"
     calls_name = f"{PREFIX}_stage_calls_total"
     for label in sorted(global_timer.totals):

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-from . import telemetry
+from . import telemetry, tracing
 from .utils.log import Log
 
 _POLICIES = ("fatal", "warn", "rollback")
@@ -146,6 +146,9 @@ class HealthMonitor:
                     "clipped gradients", it, rolled, int(gbdt.iter_))
         telemetry.emit("health_rollback", iteration=it,
                        rolled_back=int(rolled), resumed_at=int(gbdt.iter_))
+        tracing.note("health_rollback", iteration=it,
+                     rolled_back=int(rolled), resumed_at=int(gbdt.iter_))
+        tracing.dump_flight("health_rollback")
         self.clip_on = True
         if gbdt._grad_fn is not None:
             score = gbdt.score if gbdt.num_tree_per_iteration > 1 \

@@ -29,7 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..ops.partition import bucket_size
 from ..utils import faults
 from ..utils.log import Log
@@ -42,10 +42,12 @@ from .registry import ModelEntry
 
 class _Request:
     __slots__ = ("entry", "rows", "raw_score", "deadline", "event",
-                 "result", "error", "cancelled", "t_submit")
+                 "result", "error", "cancelled", "t_submit", "t_submit_pc",
+                 "span")
 
     def __init__(self, entry: ModelEntry, rows: np.ndarray, raw_score: bool,
-                 deadline: Optional[float]) -> None:
+                 deadline: Optional[float],
+                 span: Optional[tracing.Span] = None) -> None:
         self.entry = entry
         self.rows = rows
         self.raw_score = raw_score
@@ -55,6 +57,8 @@ class _Request:
         self.error: Optional[ServingError] = None
         self.cancelled = False
         self.t_submit = time.monotonic()
+        self.t_submit_pc = time.perf_counter()  # stage-mark clock basis
+        self.span = span
 
     def key(self) -> Tuple[int, bool]:
         # entry identity, not name: a hot-swap mid-queue splits the batch,
@@ -95,19 +99,22 @@ class MicroBatcher:
     # -------------------------------------------------------------- submit
 
     def submit(self, entry: ModelEntry, rows: np.ndarray, raw_score: bool,
-               timeout_s: Optional[float] = None) -> np.ndarray:
+               timeout_s: Optional[float] = None,
+               span: Optional[tracing.Span] = None) -> np.ndarray:
         """Enqueue one request and block until its batch answers, its
         deadline expires, or the service closes."""
         n = int(rows.shape[0])
         deadline = (time.monotonic() + timeout_s
                     if timeout_s is not None else None)
-        req = _Request(entry, rows, raw_score, deadline)
+        req = _Request(entry, rows, raw_score, deadline, span=span)
         with self._lock:
             if self._closed:
                 raise ServiceClosed("service is shutting down")
             if self._queued_rows + n > self.max_queue_rows:
                 self.n_overloaded += 1
                 global_timer.add_count("serve_overloaded", 1)
+                if span is not None:
+                    span.finish(terminal="rejected")
                 raise Overloaded(
                     f"admission queue full ({self._queued_rows} rows "
                     f"queued, request adds {n}, limit "
@@ -123,6 +130,10 @@ class MicroBatcher:
             req.cancelled = True  # worker skips it at assembly time
             self.n_deadline_wait_expired += 1
             global_timer.add_count("serve_deadline_expired", 1)
+            if span is not None:
+                span.add_stage("shed",
+                               time.perf_counter() - req.t_submit_pc)
+                span.finish(terminal="shed")
             raise DeadlineExceeded(
                 f"deadline of {timeout_s:.3f}s expired while "
                 f"{'queued' if req.result is None else 'in flight'}")
@@ -144,6 +155,13 @@ class MicroBatcher:
             expired = req.deadline is not None and now >= req.deadline
             if req.cancelled or expired:
                 self._queued_rows -= int(req.rows.shape[0])
+                if req.span is not None:
+                    # terminal stage: shed requests account their whole
+                    # queued life to `shed` (extends the PR-9 exact-
+                    # accounting invariant to the trace layer)
+                    req.span.add_stage(
+                        "shed", time.perf_counter() - req.t_submit_pc)
+                    req.span.finish(terminal="shed")
                 if not req.cancelled:
                     req.error = DeadlineExceeded(
                         "deadline expired before dispatch; request shed "
@@ -208,6 +226,9 @@ class MicroBatcher:
                     req.error = ServingError(f"prediction failed: {exc}")
                     req.event.set()
                 Log.warning("serving: batch dispatch error: %s", exc)
+                tracing.note("batcher_exception", error=repr(exc)[:400],
+                             requests=len(batch))
+                tracing.dump_flight("batcher_exception")
 
     def _pad(self, chunk: np.ndarray, cap: int) -> np.ndarray:
         """Pad to the power-of-two bucket the jit cache already holds."""
@@ -220,9 +241,12 @@ class MicroBatcher:
         return padded
 
     def _predict_chunk(self, entry: ModelEntry, chunk: np.ndarray,
-                       raw_score: bool, decision: Decision,
-                       cap: int) -> np.ndarray:
+                       raw_score: bool, decision: Decision, cap: int,
+                       stages: Dict[str, float]) -> np.ndarray:
+        t = time.perf_counter()
         padded = self._pad(chunk, cap)
+        t_dev = time.perf_counter()
+        stages["assembly"] += t_dev - t
         if decision.use_host:
             out = entry.predict_host(padded, raw_score)
             self.breaker.on_success(was_host=True)
@@ -243,13 +267,30 @@ class MicroBatcher:
                                    rows=int(chunk.shape[0]))
                 out = entry.predict_host(padded, raw_score)
                 self.n_host_chunks += 1
-        return np.asarray(out)[: chunk.shape[0]]
+        # `device` covers the model compute wherever it ran (host path
+        # when the breaker is open); `d2h` is the materialize + unpad —
+        # on engines whose predict already returns host arrays it reads
+        # near zero, which is itself a finding the gauge makes visible
+        t_d2h = time.perf_counter()
+        stages["device"] += t_d2h - t_dev
+        res = np.asarray(out)[: chunk.shape[0]]
+        stages["d2h"] += time.perf_counter() - t_d2h
+        return res
 
     def _dispatch(self, batch: List[_Request]) -> None:
         entry = batch[0].entry
         raw_score = batch[0].raw_score
+        t_asm = time.perf_counter()
+        batch_span = tracing.start_span("serve_batch", record_stats=False)
+        for req in batch:
+            if req.span is not None:
+                # queue_wait: submit to the moment its batch starts work
+                req.span.add_stage("queue_wait", t_asm - req.t_submit_pc)
+                batch_span.link(req.span.span_id)
+        stages = {"assembly": 0.0, "device": 0.0, "d2h": 0.0}
         X = (batch[0].rows if len(batch) == 1
              else np.concatenate([r.rows for r in batch], axis=0))
+        stages["assembly"] += time.perf_counter() - t_asm
         n = int(X.shape[0])
         decision = self.breaker.decide()
         cap = self.max_batch_rows
@@ -259,8 +300,11 @@ class MicroBatcher:
         with global_timer.scope("serve_batch"):
             for start in range(0, n, cap):
                 outs.append(self._predict_chunk(
-                    entry, X[start:start + cap], raw_score, decision, cap))
+                    entry, X[start:start + cap], raw_score, decision, cap,
+                    stages))
+        t = time.perf_counter()
         out = outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+        stages["d2h"] += time.perf_counter() - t
         self.n_batches += 1
         global_timer.add_count("serve_batches", 1)
         if telemetry.enabled():
@@ -270,9 +314,20 @@ class MicroBatcher:
         pos = 0
         for req in batch:
             k = int(req.rows.shape[0])
+            # a member rides the whole batch, so the batch's stage walls
+            # ARE its stage walls (the cost of coalescing is queue_wait)
+            if req.span is not None:
+                for stage, dur in stages.items():
+                    req.span.add_stage(stage, dur)
             req.result = out[pos:pos + k]
             pos += k
             req.event.set()
+        for stage, dur in stages.items():
+            batch_span.add_stage(stage, dur)
+        batch_span.attrs.update(rows=n, requests=len(batch),
+                                model=entry.name, version=entry.version,
+                                host=decision.use_host)
+        batch_span.finish()
 
     # --------------------------------------------------------------- stats
 

@@ -25,9 +25,10 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, Optional
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..utils.log import Log
 from ..utils.timer import global_timer
 
@@ -73,6 +74,11 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._last_compiles: Optional[int] = None
         self.transitions = 0
+        # unconditional transition history: a breaker flap must leave a
+        # trace even with telemetry off (surfaced in info() -> /statz,
+        # mirrored into the flight recorder)
+        self.last_transitions: deque = deque(maxlen=16)
+        self._pending_dump: Optional[Dict[str, Any]] = None
         global_timer.set_count("serve_breaker_state", 0)
 
     # --------------------------------------------------------------- state
@@ -95,9 +101,27 @@ class CircuitBreaker:
             self._opened_at = self._clock()
         global_timer.set_count("serve_breaker_state", _STATE_CODE[new_state])
         Log.warning("serving: breaker %s -> %s (%s)", old, new_state, why)
+        self.last_transitions.append({
+            "old": old, "new": new_state, "reason": why,
+            "wall_time": time.time(), "transition": self.transitions})
+        tracing.note("breaker_transition", old=old, new=new_state, reason=why)
+        if new_state == OPEN:
+            # the postmortem dump does I/O — defer it until the caller
+            # releases self._lock (see _maybe_dump)
+            self._pending_dump = {
+                "breaker": {"state": new_state, "reason": why,
+                            "fail_streak": self._fail_streak,
+                            "transitions": self.transitions}}
         if telemetry.enabled():
             telemetry.emit("breaker_transition", old=old, new=new_state,
                            reason=why)
+
+    def _maybe_dump(self) -> None:
+        """Fire the deferred breaker-open flight dump outside the lock."""
+        with self._lock:
+            pending, self._pending_dump = self._pending_dump, None
+        if pending is not None:
+            tracing.dump_flight("breaker_open", extra=pending)
 
     # ------------------------------------------------------------ dispatch
 
@@ -140,6 +164,7 @@ class CircuitBreaker:
             elif self._fail_streak >= self.fail_threshold:
                 self._move(OPEN, f"{self._fail_streak} consecutive "
                            f"dispatch failures (last: {exc})")
+        self._maybe_dump()
 
     # ------------------------------------------------------------- signals
 
@@ -175,4 +200,5 @@ class CircuitBreaker:
                 "success_streak": self._success_streak,
                 "transitions": self.transitions,
                 "degraded_rows": self.degraded_rows,
+                "last_transitions": list(self.last_transitions),
             }

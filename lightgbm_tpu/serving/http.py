@@ -12,13 +12,21 @@ through the batcher's single worker. No framework, no new dependency.
     DELETE /models/<name>    unload
     GET    /healthz          liveness + breaker/queue detail (always 200)
     GET    /readyz           200 once a model is loaded, else 503
-    GET    /statz            batcher/breaker/registry counters
+    GET    /statz            batcher/breaker/registry counters + the
+                             per-stage request-path quantiles
     GET    /metrics          Prometheus text exposition (exposition.py):
                              telemetry signals + global_timer counters +
                              the numeric /statz figures as serve_* gauges
+    GET    /debug/flight     on-demand flight-recorder dump (JSON; also
+                             written to the flight dir when one resolves)
 
 Every error is JSON `{"error": <code>, "detail": <msg>}` with the typed
 status from serving/errors.py; Overloaded responses carry Retry-After.
+
+Trace context: /predict honors an inbound W3C ``traceparent`` header
+(malformed ones start a fresh trace, per spec), threads the request span
+through the batcher stage marks, and echoes a ``traceparent`` naming the
+request's own span id on the success response.
 """
 from __future__ import annotations
 
@@ -28,6 +36,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
+from .. import tracing
 from ..utils.log import Log
 from .errors import InvalidRequest, Overloaded, ServingError
 from .service import PredictionService
@@ -123,6 +132,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, {"models": self.service.models()})
             elif self.path == "/metrics":
                 self._metrics()
+            elif self.path == "/debug/flight":
+                self._send_json(200, tracing.build_dump("debug_endpoint"))
+                tracing.dump_flight("debug_endpoint", force=True)
             else:
                 self._send_json(404, {"error": "not_found",
                                       "detail": self.path})
@@ -159,27 +171,39 @@ class _Handler(BaseHTTPRequestHandler):
     # ----------------------------------------------------------- handlers
 
     def _predict(self) -> None:
-        payload = self._read_json()
-        model = payload.get("model")
-        if not isinstance(model, str) or not model:
-            raise InvalidRequest("missing 'model' (string) field")
-        if "rows" not in payload:
-            raise InvalidRequest("missing 'rows' field")
-        timeout_ms = payload.get("timeout_ms")
-        timeout_s = float(timeout_ms) / 1000.0 if timeout_ms is not None \
-            else None
-        version = self.service.registry.get(model).version
-        t0 = time.monotonic()
-        preds = self.service.predict(
-            model, payload["rows"],
-            raw_score=bool(payload.get("raw_score", False)),
-            timeout_s=timeout_s)
-        self._send_json(200, {
-            "model": model,
-            "version": version,
-            "predictions": preds.tolist(),
-            "latency_ms": round((time.monotonic() - t0) * 1000.0, 3),
-        })
+        t_parse = time.perf_counter()
+        span = tracing.start_span(
+            "serve_request", traceparent=self.headers.get("traceparent"))
+        try:
+            payload = self._read_json()
+            model = payload.get("model")
+            if not isinstance(model, str) or not model:
+                raise InvalidRequest("missing 'model' (string) field")
+            if "rows" not in payload:
+                raise InvalidRequest("missing 'rows' field")
+            timeout_ms = payload.get("timeout_ms")
+            timeout_s = float(timeout_ms) / 1000.0 \
+                if timeout_ms is not None else None
+            span.add_stage("parse", time.perf_counter() - t_parse)
+            version = self.service.registry.get(model).version
+            t0 = time.monotonic()
+            preds = self.service.predict(
+                model, payload["rows"],
+                raw_score=bool(payload.get("raw_score", False)),
+                timeout_s=timeout_s, span=span)
+            t_ser = time.perf_counter()
+            self._send_json(200, {
+                "model": model,
+                "version": version,
+                "predictions": preds.tolist(),
+                "latency_ms": round((time.monotonic() - t0) * 1000.0, 3),
+                "trace_id": span.trace_id,
+            }, headers={"traceparent": span.traceparent()})
+            span.add_stage("serialize", time.perf_counter() - t_ser)
+        finally:
+            # idempotent: a shed request was already finished (terminal
+            # `shed`) inside the batcher — this records everyone else
+            span.finish()
 
     def _metrics(self) -> None:
         from ..exposition import CONTENT_TYPE, render_metrics

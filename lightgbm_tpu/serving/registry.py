@@ -25,7 +25,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from .. import checkpoint, telemetry
+from .. import checkpoint, telemetry, tracing
 from ..basic import Booster
 from ..ops.predict import pack_ensemble, predict_raw
 from ..utils import faults
@@ -197,6 +197,8 @@ class ModelRegistry:
         Log.info("serving: model '%s' -> v%d (%d trees, sha %s%s)",
                  name, entry.version, entry.booster.num_trees(), sha[:12],
                  ", verified" if verified else "")
+        tracing.note("model_swap", model=name, version=entry.version,
+                     sha256=sha[:12], verified=verified)
         if telemetry.enabled():
             telemetry.emit("model_swap", model=name, version=entry.version,
                            sha256=sha[:12], verified=verified,
@@ -208,6 +210,7 @@ class ModelRegistry:
             self.rejected_uploads += 1
         global_timer.add_count("serve_rejected_uploads", 1)
         Log.warning("serving: REJECTED upload for model '%s': %s", name, why)
+        tracing.note("model_upload_rejected", model=name, reason=why)
         if telemetry.enabled():
             telemetry.emit("model_upload_rejected", model=name, reason=why)
         raise ModelLoadError(f"model '{name}': {why}")

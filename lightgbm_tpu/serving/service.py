@@ -19,7 +19,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..health import first_nonfinite_column
 from .batcher import MicroBatcher
 from .breaker import CircuitBreaker
@@ -86,14 +86,30 @@ class PredictionService:
     # ------------------------------------------------------------- predict
 
     def predict(self, model: str, rows: Any, raw_score: bool = False,
-                timeout_s: Optional[float] = None) -> np.ndarray:
-        if self._closed:
-            raise ServiceClosed("service is shutting down")
-        self._poll_signals()
-        entry = self.registry.get(model)
-        X = self._validate(entry, rows)
-        timeout = timeout_s if timeout_s is not None else self.default_timeout_s
-        return self.batcher.submit(entry, X, raw_score, timeout)
+                timeout_s: Optional[float] = None,
+                span: Optional[tracing.Span] = None) -> np.ndarray:
+        """`span` is the request-scoped trace span; the HTTP front passes
+        one carrying the inbound traceparent context (and finishes it
+        after serialize), an in-process caller gets one made — and
+        finished — here, so every admitted request is traced either way."""
+        own_span = span is None
+        if own_span:
+            span = tracing.start_span("serve_request")
+        t_parse = time.perf_counter()
+        try:
+            if self._closed:
+                raise ServiceClosed("service is shutting down")
+            self._poll_signals()
+            entry = self.registry.get(model)
+            X = self._validate(entry, rows)
+            span.add_stage("parse", time.perf_counter() - t_parse)
+            timeout = (timeout_s if timeout_s is not None
+                       else self.default_timeout_s)
+            return self.batcher.submit(entry, X, raw_score, timeout,
+                                       span=span)
+        finally:
+            if own_span:
+                span.finish()
 
     def _validate(self, entry, rows: Any) -> np.ndarray:
         try:
@@ -163,6 +179,15 @@ class PredictionService:
             "models": self.registry.info(),
             "swaps": self.registry.swaps,
             "rejected_uploads": self.registry.rejected_uploads,
+            # per-stage request-path quantiles (tracing histograms); the
+            # /metrics flatten turns these into serve_stages_* gauges
+            "stages": tracing.stage_summary("serve_request"),
+            "flight": {
+                "enabled": tracing.enabled(),
+                "records": tracing.recorder().total,
+                "dropped": tracing.recorder().dropped,
+                "last_dump_path": tracing.last_dump_path(),
+            },
         }
 
     def close(self) -> None:

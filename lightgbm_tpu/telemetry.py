@@ -340,6 +340,16 @@ class TelemetrySession:
         if self._prev_span_hook is not None:
             self._prev_span_hook(label, start, end)
 
+    def add_span(self, label: str, start: float, end: float) -> None:
+        """Record an externally-timed span (perf_counter seconds) — the
+        tracing module feeds finished request/iteration stage spans here
+        so the Chrome-trace export is one unified timeline. Clamped at
+        the session start so a span opened pre-session can't produce a
+        negative trace timestamp."""
+        t0 = max(0.0, start - self.t0)
+        t1 = max(t0, end - self.t0)
+        self.spans.append((label, t0, t1))
+
     def counter_sample(self, name: str, value: int) -> None:
         """Timestamped gauge sample (becomes a "C" counter trace track)."""
         self._counter_samples.append((name, self._now(), int(value)))

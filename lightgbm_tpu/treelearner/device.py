@@ -970,13 +970,18 @@ class DeviceTreeLearner(SerialTreeLearner):
         histograms K candidate splits but the replay commits only as many
         as stay globally best-first — the measured ratio is the input the
         gain-adaptive wave-width work needs (ROADMAP item 1)."""
-        from .. import telemetry
+        from .. import telemetry, tracing
         n_waves = int(pending.n_waves)
         committed = tree.num_leaves - 1
         speculated = n_waves * self.wave
         global_timer.add_count("device_waves", n_waves)
         global_timer.add_count("wave_splits_committed", committed)
         global_timer.add_count("wave_splits_speculated", speculated)
+        # flight-recorder mirror: plain already-computed ints, O(1), no
+        # sync — a postmortem sees the last trees' wave shape even with
+        # telemetry off
+        tracing.note("tree_wave", waves=n_waves, committed=committed,
+                     speculated=speculated)
         if telemetry.enabled():
             telemetry.emit(
                 "tree_wave", waves=n_waves, wave_width=self.wave,

@@ -240,7 +240,11 @@ def maybe_corrupt_upload(text: str) -> str:
 
 
 def _emit_fault(kind: str, **fields) -> None:
-    """Record the injection in the telemetry stream (lazy import: this
-    module loads before the package's telemetry module in some paths)."""
-    from .. import telemetry
+    """Record the injection in the telemetry stream AND the always-on
+    flight recorder, then dump a postmortem (rate-limited per kind so a
+    fault storm costs one write, not one per firing). Lazy imports: this
+    module loads before the package's telemetry module in some paths."""
+    from .. import telemetry, tracing
     telemetry.emit("fault", kind=kind, **fields)
+    tracing.note("fault", fault=kind, **fields)
+    tracing.dump_flight(f"fault_{kind}")

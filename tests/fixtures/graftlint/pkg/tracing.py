@@ -1,0 +1,32 @@
+"""R9 fixture for the scope_exact tracing.py entry: the flight-recorder
+append (``note``) is the sanctioned unguarded hot-path emit — a bounded
+ring store, no payload formatting, no I/O — but any ``telemetry.emit``
+added alongside it must still sit under an enabled-guard."""
+from . import telemetry
+
+_RING = [None] * 16
+_SEQ = 0
+
+
+def record_span(name, duration_s):
+    telemetry.emit("span", name=name,  # line 12: VIOLATION
+                   duration_s=duration_s)
+
+
+def record_span_guarded(name, duration_s):
+    if telemetry.enabled():  # idiomatic guard: clean
+        telemetry.emit("span", name=name, duration_s=duration_s)
+
+
+def note(kind, **fields):
+    # the recorder append itself: O(1) ring store, no telemetry.emit,
+    # no guard needed — must stay clean
+    global _SEQ
+    _RING[_SEQ % len(_RING)] = (kind, fields)
+    _SEQ += 1
+
+
+def dump(sink):
+    # cold postmortem path writing through a foreign .emit-style sink:
+    # not a telemetry object, stays clean
+    sink.emit(list(_RING))

@@ -66,6 +66,16 @@ def test_bench_smoke_cpu(tmp_path):
     assert record["serve_p50_ms"] > 0
     assert record["serve_p99_ms"] >= record["serve_p50_ms"]
     assert record["serve_batches"] > 0
+    # request-path decomposition (tracing stage histograms, fed by the
+    # HTTP-driven open loop): the serving gap now has named parts, and the
+    # stages a real request must traverse carry real time
+    for field in ("serve_parse_ms_p99", "serve_queue_ms_p99",
+                  "serve_assembly_ms_p99", "serve_device_ms_p99",
+                  "serve_d2h_ms_p99", "serve_serialize_ms_p99"):
+        assert record[field] >= 0, field
+    assert record["serve_queue_ms_p99"] > 0
+    assert record["serve_device_ms_p99"] > 0
+    assert record["serve_serialize_ms_p99"] > 0
     # provenance: every record carries the environment fingerprint and the
     # ledger schema version (benchdiff refuses cross-schema comparisons)
     assert record["schema_version"] == 1

@@ -246,6 +246,24 @@ def test_r9_suppression_honored(fixture_result):
     assert len(sup) == 1 and "cold error path" in sup[0].reason
 
 
+def test_r9_tracing_scope_exact(fixture_result):
+    # tracing.py is in scope_exact: an unguarded telemetry.emit there
+    # fires even though the file sits outside the scoped directories
+    bad = _hits(fixture_result, "telemetry-hygiene", "tracing.py")
+    assert [v.line for v in bad] == [12]
+
+
+def test_r9_recorder_append_is_sanctioned(fixture_result):
+    # the flight-recorder ring append (note()) and the cold dump path's
+    # foreign sink.emit must NOT trip R9 — only telemetry.emit needs a
+    # guard; the guarded emit (line 18) is clean too
+    lines = {v.line for v in
+             _hits(fixture_result, "telemetry-hygiene", "tracing.py")
+             + _hits(fixture_result, "telemetry-hygiene", "tracing.py",
+                     suppressed=True)}
+    assert not lines & {18, 25, 26, 32}
+
+
 # -- S1 directive hygiene -------------------------------------------------
 
 def test_s1_bad_directives_are_findings(fixture_result):

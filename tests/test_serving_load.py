@@ -15,7 +15,7 @@ import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
-from lightgbm_tpu import telemetry
+from lightgbm_tpu import telemetry, tracing
 from lightgbm_tpu.serving import (DeadlineExceeded, Overloaded,
                                   PredictionService)
 from lightgbm_tpu.utils import faults
@@ -43,6 +43,7 @@ def test_open_loop_saturation_bounded_and_correct(rng, tmp_path):
     svc = PredictionService(max_batch_rows=128,
                             max_queue_rows=max_queue_rows,
                             batch_window_s=0.0)
+    tracing.reset()  # clean recorder: stage accounting asserted below
     telemetry.start(str(tmp_path / "tele"), label="serve_load")
     try:
         svc.load_model("m", booster=bst)
@@ -128,3 +129,28 @@ def test_open_loop_saturation_bounded_and_correct(rng, tmp_path):
     # shedding really suppressed dispatches: strictly fewer rows hit the
     # device than were admitted
     assert batch_rows < admitted * rows_per_req
+
+    # 5. request-path tracing accounts for the wall: for every COMPLETED
+    #    request span the stage marks are disjoint sections of the span
+    #    (sum <= wall), and on the median span the decomposition explains
+    #    most of it — queue_wait + the batch walls dominate under
+    #    saturation (thread wake-up latency is the untracked remainder)
+    spans = [r for r in tracing.recorder().snapshot()
+             if r["kind"] == "span" and r["name"] == "serve_request"]
+    done = [s for s in spans if "terminal" not in s]
+    shed = [s for s in spans if s.get("terminal") == "shed"]
+    assert len(done) == len(ok)
+    coverages = []
+    for s in done:
+        wall_ms = (s["t1"] - s["t0"]) * 1000.0
+        total_ms = sum(s["stages_ms"].values())
+        assert total_ms <= wall_ms * 1.05 + 1.0, s
+        assert {"queue_wait", "device"} <= set(s["stages_ms"]), s
+        coverages.append(total_ms / max(wall_ms, 1e-9))
+    if coverages:  # full saturation may complete zero requests in-deadline
+        coverages.sort()
+        assert coverages[len(coverages) // 2] >= 0.5, coverages
+    # 6. every shed/expired request carries the terminal `shed` stage —
+    #    the postmortem can tell a shed from a request that simply vanished
+    assert shed, "saturation produced no shed spans"
+    assert all("shed" in s["stages_ms"] for s in shed)

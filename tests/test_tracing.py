@@ -1,0 +1,439 @@
+"""Tracing + flight-recorder suite: W3C traceparent handling, the
+log-bucketed stage histograms, ring-buffer eviction determinism under
+concurrent writers, end-to-end header propagation over the HTTP front,
+breaker transitions recorded with telemetry OFF, the dump format round
+trip through flightview and teldiff --self-check, and bit-identical
+numerics with the recorder on vs compiled out.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import telemetry, tracing
+from lightgbm_tpu.serving import CircuitBreaker, PredictionService
+from lightgbm_tpu.serving.http import serve
+from lightgbm_tpu.utils import faults
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 5}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    """Each test starts from an empty ring + stats and leaves the module
+    enabled (the process default) for the next suite."""
+    tracing.reset()
+    tracing.set_enabled(True)
+    yield
+    faults.clear()
+    tracing.reset()
+    tracing.set_enabled(True)
+
+
+def _train_small(rng, rounds=4):
+    X = rng.rand(400, 10)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float64)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=rounds)
+    return bst, X
+
+
+# -- W3C trace context ----------------------------------------------------
+
+def test_traceparent_roundtrip():
+    tid, sid = tracing.new_trace_id(), tracing.new_span_id()
+    header = tracing.format_traceparent(tid, sid)
+    assert tracing.parse_traceparent(header) == (tid, sid)
+    # case-insensitive with surrounding whitespace, per spec
+    assert tracing.parse_traceparent("  " + header.upper() + " ") \
+        == (tid, sid)
+
+
+@pytest.mark.parametrize("header", [
+    None, "", "garbage", "00-short-beef-01",
+    "00-" + "g" * 32 + "-" + "a" * 16 + "-01",          # non-hex
+    "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",          # forbidden version
+    "00-" + "0" * 32 + "-" + "b" * 16 + "-01",          # zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",          # zero parent id
+    "00-" + "a" * 32 + "-" + "b" * 16 + "-01-extra",    # trailing junk
+])
+def test_traceparent_malformed_restarts_trace(header):
+    # malformed context restarts the trace (W3C behaviour), never raises
+    assert tracing.parse_traceparent(header) is None
+    span = tracing.start_span("t", traceparent=header)
+    assert span.parent_id is None and len(span.trace_id) == 32
+
+
+def test_span_ancestry_and_stage_accumulation():
+    parent = tracing.start_span("outer")
+    child = tracing.start_span("inner", parent=parent)
+    assert child.trace_id == parent.trace_id
+    assert child.parent_id == parent.span_id
+    child.add_stage("device", 0.010)
+    child.add_stage("device", 0.005)  # chunked dispatch accumulates
+    child.finish()
+    assert child.stages["device"] == pytest.approx(0.015)
+    # finish is idempotent and freezes the stage map
+    child.add_stage("device", 1.0)
+    child.finish(terminal="late")
+    assert child.stages["device"] == pytest.approx(0.015)
+    assert child.terminal is None
+    parent.finish()
+
+
+# -- stage histograms -----------------------------------------------------
+
+def test_stage_histogram_quantiles_conservative():
+    h = tracing.StageHistogram()
+    for ms in (1.0, 2.0, 4.0, 8.0, 100.0):
+        h.record(ms / 1000.0)
+    h.record(-1.0)  # clock skew clamps to bucket 0, never raises
+    assert h.n == 6
+    # bucket upper bound: reported quantile >= true value, within one
+    # geometric bucket width (25%)
+    p99 = h.quantile_s(0.99)
+    assert 0.100 <= p99 <= 0.100 * 1.25
+    assert h.quantile_s(0.50) >= 0.002
+
+
+def test_stage_summary_and_gauges_from_finished_spans():
+    for _ in range(3):
+        s = tracing.start_span("serve_request")
+        s.add_stage("device", 0.004)
+        s.add_stage("queue_wait", 0.001)
+        s.finish()
+    summary = tracing.stage_summary("serve_request")
+    assert summary["device"]["count"] == 3
+    assert summary["device"]["p99_ms"] >= 4.0
+    assert summary["device"]["total_ms"] == pytest.approx(12.0, rel=0.01)
+    gauges = tracing.quantile_gauges()
+    assert gauges["serve_request_stage_device_p99_ms"] >= 4.0
+    assert "serve_request_stage_queue_wait_p50_ms" in gauges
+
+
+def test_quantile_gauges_round_trip_through_exposition():
+    from lightgbm_tpu import exposition
+
+    s = tracing.start_span("serve_request")
+    s.add_stage("device", 0.002)
+    s.finish()
+    parsed = exposition.parse_exposition(exposition.render_metrics())
+    key = ("lgbm_tpu_serve_request_stage_device_p99_ms", ())
+    assert key in parsed and parsed[key] >= 2.0
+
+
+# -- flight recorder ring -------------------------------------------------
+
+def test_ring_eviction_deterministic_under_concurrent_writers():
+    rec = tracing.FlightRecorder(capacity=64)
+    n_threads, per_thread = 8, 100
+
+    def writer(tid):
+        for i in range(per_thread):
+            rec.note("w", {"tid": tid, "i": i})
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * per_thread
+    assert rec.total == total
+    assert rec.dropped == total - 64
+    snap = rec.snapshot()
+    # exactly the newest `capacity` records survive, in sequence order,
+    # with no gaps and no duplicates — eviction is deterministic
+    assert [r["seq"] for r in snap] == list(range(total - 64, total))
+    ts = [r["t"] for r in snap]
+    assert all(b <= a for b, a in zip(ts, ts[1:]))
+
+
+def test_recorder_disabled_drops_everything():
+    tracing.set_enabled(False)
+    tracing.note("never", x=1)
+    s = tracing.start_span("serve_request")
+    s.add_stage("device", 0.001)
+    s.finish()
+    assert tracing.recorder().total == 0
+    assert tracing.stage_summary("serve_request") == {}
+    assert tracing.dump_flight("unit") is None and tracing.last_dump() is None
+
+
+def test_dump_rate_limited_per_reason(tmp_path, monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_FLIGHT_DIR", str(tmp_path))
+    tracing.note("hello", n=1)
+    p1 = tracing.dump_flight("storm")
+    assert p1 and os.path.isfile(p1)
+    # a second firing inside the interval is swallowed...
+    assert tracing.dump_flight("storm") is None
+    # ...but a different reason and a forced dump still write
+    assert tracing.dump_flight("other") is not None
+    assert tracing.dump_flight("storm", force=True) == p1  # same file: bounded
+
+
+# -- dump format round trip ----------------------------------------------
+
+def test_dump_flightview_teldiff_round_trip(tmp_path, monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_FLIGHT_DIR", str(tmp_path))
+    for i in range(5):
+        tracing.note("tick", i=i)
+    s = tracing.start_span("serve_request")
+    s.add_stage("device", 0.003)
+    s.finish()
+    path = tracing.dump_flight("unit_test", extra={"k": "v"})
+    assert path == str(tmp_path / "flight-unit_test.json")
+    dump = json.loads((tmp_path / "flight-unit_test.json").read_text())
+    assert dump["format"] == "lgbm-flight" and dump["version"] == 1
+    assert dump["reason"] == "unit_test" and dump["extra"] == {"k": "v"}
+    assert [e["kind"] for e in dump["events"][:5]] == ["tick"] * 5
+    assert dump["stage_summary"]["serve_request"]["device"]["count"] == 1
+
+    # flightview renders + emits a loadable Chrome trace
+    trace_out = tmp_path / "trace.json"
+    fv = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "flightview.py"),
+         path, "--trace", str(trace_out)],
+        capture_output=True, text=True, timeout=60)
+    assert fv.returncode == 0, fv.stderr
+    assert "unit_test" in fv.stdout
+    trace = json.loads(trace_out.read_text())
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "serve_request.device" in names
+
+    # teldiff --self-check accepts the dump format
+    td = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "teldiff.py"),
+         "--self-check", path], capture_output=True, text=True, timeout=60)
+    assert td.returncode == 0, td.stdout + td.stderr
+
+
+# -- HTTP propagation -----------------------------------------------------
+
+@pytest.fixture()
+def served(rng):
+    bst, X = _train_small(rng)
+    svc = PredictionService(max_batch_rows=1024, batch_window_s=0.0)
+    svc.load_model("m", booster=bst)
+    server, _ = serve(svc, port=0)
+    yield server.port, bst, svc
+    server.shutdown()
+    svc.close()
+
+
+def _post_predict(port, payload, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps(payload).encode(), method="POST")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return (resp.status, json.loads(resp.read()),
+                resp.headers.get("traceparent"))
+
+
+def _wait_for_request_spans(pred, timeout_s=5.0):
+    """The handler finishes (and records) the span AFTER the response bytes
+    are on the wire, so poll briefly instead of racing the handler thread."""
+    deadline = time.perf_counter() + timeout_s
+    while True:
+        spans = [r for r in tracing.recorder().snapshot()
+                 if r["kind"] == "span" and r["name"] == "serve_request"
+                 and pred(r)]
+        if spans or time.perf_counter() >= deadline:
+            return spans
+        time.sleep(0.01)
+
+
+def test_inbound_traceparent_honored_and_echoed(served, rng):
+    port, _, _ = served
+    rows = rng.rand(4, 10).tolist()
+    inbound_trace = "c" * 32
+    header = f"00-{inbound_trace}-{'b' * 16}-01"
+    status, body, echoed = _post_predict(
+        port, {"model": "m", "rows": rows}, {"traceparent": header})
+    assert status == 200
+    # same trace id end to end; the echoed span id is the SERVER's span
+    assert body["trace_id"] == inbound_trace
+    parsed = tracing.parse_traceparent(echoed)
+    assert parsed is not None and parsed[0] == inbound_trace
+    assert parsed[1] != "b" * 16
+    # the finished request span landed in the recorder with ancestry
+    mine = _wait_for_request_spans(
+        lambda s: s["trace_id"] == inbound_trace)
+    assert mine and mine[-1]["parent_id"] == "b" * 16
+
+
+def test_missing_or_malformed_traceparent_generates_fresh(served, rng):
+    port, _, _ = served
+    rows = rng.rand(2, 10).tolist()
+    _, body1, tp1 = _post_predict(port, {"model": "m", "rows": rows})
+    _, body2, tp2 = _post_predict(port, {"model": "m", "rows": rows},
+                                  {"traceparent": "not-a-traceparent"})
+    for body, tp in ((body1, tp1), (body2, tp2)):
+        assert len(body["trace_id"]) == 32
+        assert tracing.parse_traceparent(tp)[0] == body["trace_id"]
+    assert body1["trace_id"] != body2["trace_id"]
+
+
+def test_request_span_stages_cover_the_wall(served, rng):
+    port, _, _ = served
+    rows = rng.rand(32, 10).tolist()
+    t0 = time.perf_counter()
+    status, _, _ = _post_predict(port, {"model": "m", "rows": rows})
+    wall_ms = (time.perf_counter() - t0) * 1000.0
+    assert status == 200
+    spans = _wait_for_request_spans(lambda s: "serialize" in s["stages_ms"])
+    assert spans
+    stages = spans[-1]["stages_ms"]
+    # the full decomposition is present...
+    for name in ("parse", "queue_wait", "assembly", "device", "d2h",
+                 "serialize"):
+        assert name in stages, sorted(stages)
+    # ...and sums to no more than the observed client wall (stages are
+    # disjoint sections of one request; client wall adds socket overhead)
+    assert 0.0 < sum(stages.values()) <= wall_ms
+    # /statz surfaces the same figures as quantiles
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statz", timeout=10) as resp:
+        stz = json.loads(resp.read())
+    assert stz["stages"]["device"]["count"] >= 1
+    assert stz["flight"]["enabled"] and stz["flight"]["records"] > 0
+
+
+def test_debug_flight_endpoint(served):
+    port, _, _ = served
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/flight", timeout=10) as resp:
+        dump = json.loads(resp.read())
+    assert dump["format"] == "lgbm-flight"
+    assert dump["reason"] == "debug_endpoint"
+
+
+# -- breaker postmortems (telemetry OFF throughout) -----------------------
+
+def test_breaker_transitions_recorded_without_telemetry(tmp_path,
+                                                        monkeypatch, rng):
+    assert not telemetry.enabled()
+    monkeypatch.setenv("LGBM_TPU_FLIGHT_DIR", str(tmp_path))
+    bst, X = _train_small(rng)
+    svc = PredictionService(max_batch_rows=512, batch_window_s=0.0,
+                            breaker=CircuitBreaker(cooldown_s=30.0))
+    try:
+        svc.load_model("m", booster=bst)
+        expected = bst.predict(X[:16])
+        faults.install("predict_fail@1:10")
+        for _ in range(4):
+            out = svc.predict("m", X[:16])
+            # host fallback keeps answers bit-identical through the flap
+            assert np.array_equal(out, expected)
+            if svc.breaker.state == "open":
+                break
+        faults.clear()
+        assert svc.breaker.state == "open"
+        # satellite (a): the transition history exists with telemetry off
+        info = svc.breaker.info()
+        opens = [t for t in info["last_transitions"] if t["new"] == "open"]
+        assert opens and "failure" in opens[0]["reason"]
+        # ...mirrored into the recorder...
+        recorded = [r for r in tracing.recorder().snapshot()
+                    if r["kind"] == "breaker_transition"
+                    and r["new"] == "open"]
+        assert recorded
+        # ...and the auto-dump fired with the breaker context attached
+        dump_path = tmp_path / "flight-breaker_open.json"
+        assert dump_path.is_file()
+        dump = json.loads(dump_path.read_text())
+        assert dump["telemetry_enabled"] is False
+        assert dump["extra"]["breaker"]["state"] == "open"
+        assert any(e.get("kind") == "fault" for e in dump["events"])
+    finally:
+        faults.clear()
+        svc.close()
+
+
+# -- numerics: recorder on == recorder off --------------------------------
+
+def test_bit_identical_numerics_with_recorder_on_and_off(rng):
+    X = rng.rand(500, 12)
+    y = (X[:, 0] - 0.3 * X[:, 1] > 0.2).astype(np.float64)
+    Q = rng.rand(64, 12)
+
+    def run():
+        bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=6)
+        return bst.model_to_string(), bst.predict(Q)
+
+    tracing.set_enabled(True)
+    model_on, preds_on = run()
+    assert tracing.recorder().total > 0  # the run really was recorded
+    tracing.reset()
+    tracing.set_enabled(False)
+    model_off, preds_off = run()
+    assert tracing.recorder().total == 0
+    assert model_on == model_off
+    assert np.array_equal(preds_on, preds_off)
+
+
+# -- overhead budget ------------------------------------------------------
+
+# per-iteration recorder call sites: iteration span finish + a handful of
+# note() sites (waves, faults); generous stand-in like telemetry's model
+_NOTE_SITES_PER_ITER = 500
+
+
+@pytest.mark.slow
+def test_recorder_overhead_under_one_percent(rng):
+    n = 100_000
+    tracing.set_enabled(True)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tracing.note("hot", a=1, b=2)
+    on_cost = (time.perf_counter() - t0) / n
+    tracing.set_enabled(False)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tracing.note("hot", a=1, b=2)
+    off_cost = (time.perf_counter() - t0) / n
+    tracing.set_enabled(True)
+
+    X = rng.rand(2000, 20)
+    y = (X[:, 0] > 0.5).astype(np.float64)
+    ds = lgb.Dataset(X, label=y)
+    lgb.train(PARAMS, ds, num_boost_round=2)  # warm jit caches
+    rounds = 10
+    t0 = time.perf_counter()
+    lgb.train(PARAMS, ds, num_boost_round=rounds)
+    iter_wall = (time.perf_counter() - t0) / rounds
+
+    # the enabled-vs-compiled-out DELTA, modeled at a generous call-site
+    # count, must stay under the 1% budget
+    delta = max(0.0, on_cost - off_cost)
+    modeled_pct = 100.0 * _NOTE_SITES_PER_ITER * delta / iter_wall
+    assert modeled_pct < 1.0, (
+        "recorder append too hot: %.3f%% modeled overhead "
+        "(%.0f ns/site on, %.0f ns/site off, %.1f ms/iter)" % (
+            modeled_pct, on_cost * 1e9, off_cost * 1e9, iter_wall * 1e3))
+
+
+# -- training spans -------------------------------------------------------
+
+def test_train_iteration_spans_recorded(rng):
+    X = rng.rand(300, 8)
+    y = (X[:, 0] > 0.5).astype(np.float64)
+    lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=3)
+    spans = [r for r in tracing.recorder().snapshot()
+             if r["kind"] == "span" and r["name"] == "train_iteration"]
+    assert len(spans) == 3
+    assert [s["attrs"]["iteration"] for s in spans] == [0, 1, 2]
+    assert all("boost" in s["stages_ms"] for s in spans)
+    summary = tracing.stage_summary("train_iteration")
+    assert summary["boost"]["count"] == 3

@@ -1,0 +1,169 @@
+"""Render a flight-recorder dump (lightgbm_tpu/tracing.py) for humans.
+
+    python tools/flightview.py DUMP.json [--trace OUT.json] [--events N]
+    python tools/flightview.py --url http://127.0.0.1:8080 [--out DUMP.json]
+
+Prints the postmortem header (reason, drop accounting), the breaker
+transition history captured in the ring, the per-stage latency quantile
+table, top counters, and the tail of the event ring. `--trace` exports
+the dump's span records as a Chrome trace (chrome://tracing /
+ui.perfetto.dev) — stages laid out contiguously from each span's start,
+one track per span family. `--url` fetches a live dump from a running
+server's /debug/flight endpoint.
+
+Stdlib only — usable on a box with nothing but the dump file.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Any, Dict, List
+
+FORMAT = "lgbm-flight"
+
+
+def load_dump(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as fh:
+        dump = json.load(fh)
+    return _validate(dump, path)
+
+
+def fetch_dump(url: str) -> Dict[str, Any]:
+    target = url.rstrip("/") + "/debug/flight"
+    with urllib.request.urlopen(target, timeout=30) as resp:
+        dump = json.loads(resp.read())
+    return _validate(dump, target)
+
+
+def _validate(dump: Any, origin: str) -> Dict[str, Any]:
+    if not isinstance(dump, dict) or dump.get("format") != FORMAT:
+        raise SystemExit(
+            f"flightview: {origin} is not a {FORMAT} dump "
+            f"(format={dump.get('format') if isinstance(dump, dict) else '?'})")
+    return dump
+
+
+def render(dump: Dict[str, Any], events_tail: int = 20) -> str:
+    lines: List[str] = []
+    lines.append(f"flight dump · reason={dump.get('reason')} "
+                 f"pid={dump.get('pid')} "
+                 f"telemetry={'on' if dump.get('telemetry_enabled') else 'off'}")
+    lines.append(f"  ring: {len(dump.get('events', []))} records held, "
+                 f"{dump.get('total_records', 0)} total, "
+                 f"{dump.get('dropped', 0)} dropped "
+                 f"(capacity {dump.get('capacity', '?')})")
+
+    transitions = [e for e in dump.get("events", [])
+                   if e.get("kind") == "breaker_transition"]
+    if transitions:
+        lines.append("breaker transitions (in ring):")
+        for t in transitions:
+            lines.append(f"  seq={t['seq']:>6}  {t.get('old')} -> "
+                         f"{t.get('new')}  ({t.get('reason')})")
+
+    summary = dump.get("stage_summary", {})
+    if summary:
+        lines.append("stage latency quantiles:")
+        lines.append(f"  {'span':<16} {'stage':<12} {'count':>8} "
+                     f"{'p50 ms':>10} {'p99 ms':>10} {'total ms':>11}")
+        for span_name in sorted(summary):
+            for stage, q in summary[span_name].items():
+                lines.append(
+                    f"  {span_name:<16} {stage:<12} {q['count']:>8} "
+                    f"{q['p50_ms']:>10.3f} {q['p99_ms']:>10.3f} "
+                    f"{q['total_ms']:>11.1f}")
+
+    counters = dump.get("counters", {})
+    if counters:
+        lines.append("counters:")
+        for key in sorted(counters):
+            lines.append(f"  {key}: {counters[key]}")
+
+    events = dump.get("events", [])
+    if events:
+        tail = events[-events_tail:]
+        lines.append(f"last {len(tail)} records:")
+        for ev in tail:
+            fields = {k: v for k, v in ev.items()
+                      if k not in ("seq", "t", "kind")}
+            lines.append(f"  seq={ev['seq']:>6} t={ev['t']:>14.6f} "
+                         f"{ev['kind']:<20} {json.dumps(fields)[:120]}")
+    return "\n".join(lines)
+
+
+def build_trace(dump: Dict[str, Any]) -> Dict[str, Any]:
+    """Chrome-trace JSON from the dump's span records: B/E pairs per
+    stage, contiguous from each span's start; non-span records become
+    instant events on their own track."""
+    events = dump.get("events", [])
+    spans = [e for e in events if e.get("kind") == "span"]
+    others = [e for e in events if e.get("kind") != "span"]
+    t_base = min([s.get("t0", s["t"]) for s in spans]
+                 + [e["t"] for e in others], default=0.0)
+    tids = {}
+
+    def tid(name: str) -> int:
+        if name not in tids:
+            tids[name] = len(tids) + 1
+        return tids[name]
+
+    trace: List[Dict[str, Any]] = []
+    for s in spans:
+        name = s.get("name", "span")
+        t = float(s.get("t0", s["t"]))
+        for stage, dur_ms in (s.get("stages_ms") or {}).items():
+            dur = float(dur_ms) / 1000.0
+            trace.append({"name": f"{name}.{stage}", "ph": "B", "pid": 1,
+                          "tid": tid(name),
+                          "ts": round((t - t_base) * 1e6, 3),
+                          "args": {"trace_id": s.get("trace_id"),
+                                   "span_id": s.get("span_id")}})
+            trace.append({"name": f"{name}.{stage}", "ph": "E", "pid": 1,
+                          "tid": tid(name),
+                          "ts": round((t + dur - t_base) * 1e6, 3)})
+            t += dur
+    for e in others:
+        trace.append({"name": e["kind"], "ph": "i", "pid": 1,
+                      "tid": tid("events"), "s": "g",
+                      "ts": round((e["t"] - t_base) * 1e6, 3),
+                      "args": {k: v for k, v in e.items()
+                               if k not in ("t", "kind")}})
+    trace.sort(key=lambda ev: (ev["ts"], 0 if ev["ph"] == "E" else 1))
+    meta = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": t,
+             "args": {"name": label}} for label, t in sorted(tids.items())]
+    return {"traceEvents": meta + trace,
+            "displayTimeUnit": "ms",
+            "otherData": {"reason": dump.get("reason"),
+                          "source": "flightview"}}
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="flightview", description=__doc__.splitlines()[0])
+    ap.add_argument("dump", nargs="?", help="path to a flight-*.json dump")
+    ap.add_argument("--url", help="fetch a live dump from this server's "
+                                  "/debug/flight instead of a file")
+    ap.add_argument("--out", help="with --url: also save the fetched dump")
+    ap.add_argument("--trace", help="write a Chrome trace JSON here")
+    ap.add_argument("--events", type=int, default=20,
+                    help="event-ring tail length to print (default 20)")
+    args = ap.parse_args(argv)
+    if bool(args.dump) == bool(args.url):
+        ap.error("pass exactly one of DUMP.json or --url")
+    dump = fetch_dump(args.url) if args.url else load_dump(args.dump)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(dump, fh, indent=1, sort_keys=True)
+        print(f"flightview: saved dump -> {args.out}")
+    print(render(dump, events_tail=args.events))
+    if args.trace:
+        with open(args.trace, "w", encoding="utf-8") as fh:
+            json.dump(build_trace(dump), fh)
+        print(f"flightview: wrote Chrome trace -> {args.trace}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -64,8 +64,12 @@ class TelemetryHygieneRule(Rule):
     scope_prefixes = ("treelearner/", "parallel/", "serving/")
     # perfmodel/exposition sit on the scrape path: a /metrics render or a
     # per-dispatch capture hook runs with telemetry off too, so unguarded
-    # emits there cost every caller, not just telemetry users
-    scope_exact = ("ops/predict.py", "perfmodel.py", "exposition.py")
+    # emits there cost every caller, not just telemetry users. tracing.py
+    # is IN scope on purpose: its recorder append (tracing.note) is the
+    # one sanctioned unguarded hot-path emit — O(1), allocation-bounded,
+    # no I/O — so any telemetry.emit added there must still be guarded.
+    scope_exact = ("ops/predict.py", "perfmodel.py", "exposition.py",
+                   "tracing.py")
 
     def check(self, pkg: Package) -> Iterable[Violation]:
         out: List[Violation] = []

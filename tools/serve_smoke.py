@@ -1,19 +1,24 @@
 """CI serving smoke: boot the hardened prediction server, drive it over
-HTTP with concurrent clients — including a corrupt upload and a
-deadline-expired request — and assert the service stays healthy and
-bit-exact throughout.
+HTTP with concurrent clients — including a corrupt upload, a
+deadline-expired request, and a fault-injected breaker flap — and assert
+the service stays healthy and bit-exact throughout.
 
     python tools/serve_smoke.py [telemetry_dir]
 
 Exits nonzero on any violated invariant. When a telemetry dir is given the
 run records a full event stream there (validate it afterwards with
-`python tools/teldiff.py --self-check <dir>`).
+`python tools/teldiff.py --self-check <dir>`). Flight-recorder dumps land
+in the same dir (a temp dir otherwise): the breaker-open scenario proves
+the auto-dump end to end — dump present, OPEN transition + preceding
+events inside, flightview renders it, teldiff accepts the format.
 """
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -36,11 +41,13 @@ def main() -> int:
 
     import lightgbm_tpu as lgb
     from lightgbm_tpu import checkpoint, telemetry
-    from lightgbm_tpu.serving import PredictionService
+    from lightgbm_tpu.serving import CircuitBreaker, PredictionService
     from lightgbm_tpu.serving.http import serve
     from lightgbm_tpu.utils import faults
 
     tel_dir = sys.argv[1] if len(sys.argv) > 1 else None
+    flight_dir = tel_dir or tempfile.mkdtemp(prefix="serve-smoke-flight-")
+    os.environ["LGBM_TPU_FLIGHT_DIR"] = flight_dir
     if tel_dir:
         telemetry.start(tel_dir, label="serve_smoke")
 
@@ -55,7 +62,9 @@ def main() -> int:
         model_path = f"{td}/model.txt"
         checkpoint.save_checkpoint(bst, model_path)  # text + .ckpt sidecar
 
-        svc = PredictionService(max_batch_rows=1024, batch_window_s=0.001)
+        # short breaker cooldown so the flap scenario recovers in-smoke
+        svc = PredictionService(max_batch_rows=1024, batch_window_s=0.001,
+                                breaker=CircuitBreaker(cooldown_s=0.4))
         server, _ = serve(svc, port=0)
         port = server.port
         failures = []
@@ -124,6 +133,71 @@ def main() -> int:
                              {"model": "m", "rows": [[0.0] * 5]})
         check("typed 400 names feature count", status == 400
               and "5 features" in body.get("detail", ""))
+
+        # breaker flap under injected dispatch failures: requests keep
+        # answering bit-exact from the host path while the breaker opens,
+        # and the flight recorder auto-dumps the postmortem
+        faults.install("predict_fail@1:10")
+        flap_exact = True
+        for _ in range(6):
+            status, body = _call(port, "/predict",
+                                 {"model": "m", "rows": queries[0].tolist()})
+            flap_exact = flap_exact and status == 200 and np.array_equal(
+                np.asarray(body["predictions"], np.float32), expected[0])
+            if svc.breaker.state == "open":
+                break
+        faults.clear()
+        check("breaker opened under predict_fail",
+              svc.breaker.state == "open", svc.breaker.state)
+        check("bit-exact 200s through the flap (host fallback)", flap_exact)
+
+        dump_path = os.path.join(flight_dir, "flight-breaker_open.json")
+        check("flight dump written on breaker open",
+              os.path.isfile(dump_path), dump_path)
+        dump = {}
+        if os.path.isfile(dump_path):
+            with open(dump_path, "r", encoding="utf-8") as fh:
+                dump = json.load(fh)
+        opens = [e for e in dump.get("events", [])
+                 if e.get("kind") == "breaker_transition"
+                 and e.get("new") == "open"]
+        check("dump contains the OPEN transition", bool(opens))
+        check("dump holds the events preceding the transition",
+              bool(opens) and any(e["seq"] < opens[0]["seq"]
+                                  for e in dump.get("events", [])))
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        fv = subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "flightview.py"),
+             dump_path, "--trace",
+             # NOT flight-*.json: teldiff validates that glob as dumps
+             os.path.join(flight_dir, "flightview-trace.json")],
+            capture_output=True, text=True)
+        check("flightview renders the dump", fv.returncode == 0,
+              (fv.stderr or fv.stdout)[-200:])
+        td = subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "teldiff.py"),
+             "--self-check", dump_path], capture_output=True, text=True)
+        check("teldiff --self-check accepts the dump format",
+              td.returncode == 0, (td.stderr or td.stdout)[-200:])
+
+        # recovery: cooldown elapses, probe dispatches close the breaker
+        time.sleep(0.5)
+        for _ in range(5):
+            _call(port, "/predict",
+                  {"model": "m", "rows": queries[0].tolist()})
+            if svc.breaker.state == "closed":
+                break
+        check("breaker recovered to closed", svc.breaker.state == "closed",
+              svc.breaker.state)
+        status, stz = _call(port, "/statz")
+        check("statz surfaces the transition history", status == 200
+              and any(t.get("new") == "open" for t in
+                      stz["breaker"].get("last_transitions", [])))
+        check("statz carries request stage quantiles", status == 200
+              and stz.get("stages", {}).get("queue_wait", {})
+                    .get("count", 0) > 0
+              and "device" in stz.get("stages", {}), str(stz.get("stages"))[:200])
 
         # /healthz stays green through all of the above
         status, health = _call(port, "/healthz")

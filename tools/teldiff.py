@@ -25,7 +25,10 @@ prints per-label span-duration p50/p99 recovered from trace.json.
 `--self-check` validates a
 run's artifacts (parseable JSONL, required event types, monotonic trace
 timestamps, matched B/E span pairs) and exits nonzero on any violation —
-CI runs it on the smoke-train artifact.
+CI runs it on the smoke-train artifact. It also accepts the flight-
+recorder dump format (lightgbm_tpu/tracing.py): pass a `flight-*.json`
+file directly, or a run dir — any flight dumps sitting in the dir are
+validated alongside the event stream.
 """
 from __future__ import annotations
 
@@ -239,9 +242,65 @@ def diff(base_dir: str, cand_dir: str, threshold: float) -> int:
     return 0
 
 
+FLIGHT_FORMAT = "lgbm-flight"
+
+
+def check_flight_dump(path: str) -> List[str]:
+    """Validate one flight-recorder dump: format/version header, events
+    as a list of seq-ordered records with numeric timestamps, counter
+    map, drop accounting. Returns problems ([] = valid)."""
+    problems: List[str] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            dump = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable flight dump ({e})"]
+    if not isinstance(dump, dict) or dump.get("format") != FLIGHT_FORMAT:
+        return [f"{path}: not a {FLIGHT_FORMAT} dump"]
+    if not isinstance(dump.get("version"), int):
+        problems.append(f"{path}: missing integer version")
+    if not dump.get("reason"):
+        problems.append(f"{path}: missing reason")
+    events = dump.get("events")
+    if not isinstance(events, list):
+        problems.append(f"{path}: events is not a list")
+        events = []
+    last_seq = -1
+    for ev in events:
+        if not isinstance(ev, dict) or not isinstance(ev.get("seq"), int) \
+                or not isinstance(ev.get("t"), (int, float)) \
+                or not ev.get("kind"):
+            problems.append(f"{path}: malformed ring record: {ev}")
+            break
+        if ev["seq"] <= last_seq:
+            problems.append(
+                f"{path}: ring seq not strictly increasing at {ev['seq']}")
+            break
+        last_seq = ev["seq"]
+    if not isinstance(dump.get("counters"), dict):
+        problems.append(f"{path}: missing counters map")
+    dropped = dump.get("dropped")
+    total = dump.get("total_records")
+    if not isinstance(dropped, int) or not isinstance(total, int) \
+            or dropped < 0 or dropped > total:
+        problems.append(f"{path}: inconsistent drop accounting "
+                        f"(dropped={dropped} total={total})")
+    return problems
+
+
 def self_check(run_dir: str) -> int:
     """Artifact validity: parseable JSONL with the required event types,
-    trace.json with monotonic timestamps and matched B/E span pairs."""
+    trace.json with monotonic timestamps and matched B/E span pairs.
+    A `flight-*.json` path validates as a flight dump instead; a run dir
+    containing flight dumps validates those too."""
+    if os.path.isfile(run_dir):
+        problems = check_flight_dump(run_dir)
+        if problems:
+            for p in problems:
+                print(f"self-check FAIL: {p}", file=sys.stderr)
+            return 1
+        print(f"self-check OK: {run_dir} (flight dump)")
+        return 0
     problems: List[str] = []
     events = _read_events(run_dir)  # exits on parse failure
     types = {e.get("ev") for e in events}
@@ -296,6 +355,14 @@ def self_check(run_dir: str) -> int:
                     problems.append(
                         f"{TRACE_FILE}: {d} unmatched B event(s) on "
                         f"track {key}")
+    try:
+        flight_dumps = sorted(
+            f for f in os.listdir(run_dir)
+            if f.startswith("flight-") and f.endswith(".json"))
+    except OSError:
+        flight_dumps = []
+    for name in flight_dumps:
+        problems.extend(check_flight_dump(os.path.join(run_dir, name)))
     if problems:
         for p in problems:
             print(f"self-check FAIL: {p}", file=sys.stderr)
@@ -308,8 +375,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="teldiff", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("--self-check", metavar="RUN_DIR",
-                    help="validate a run's artifacts and exit")
+    ap.add_argument("--self-check", metavar="RUN_DIR_OR_DUMP",
+                    help="validate a run's artifacts (or a flight-*.json "
+                         "dump) and exit")
     sub = ap.add_subparsers(dest="cmd")
     p_sum = sub.add_parser("summarize", help="print one run's summary")
     p_sum.add_argument("run_dir")
