@@ -349,6 +349,61 @@ def run_bench(n_rows: int) -> dict:
             out["quantized_auc"] = round(_auc(yh, bq.predict(Xh)), 4)
         except Exception as e:  # noqa: BLE001 - secondary must not kill primary
             out["quantized_error"] = repr(e)[:200]
+
+    # out-of-core streaming capture (docs/STREAMING.md): chunked ingest
+    # through RowBlockStore, then training under a deliberately starved
+    # HBM budget (2 of ~8 blocks resident) so the numbers reflect real
+    # evictions and prefetch overlap, never the pin-everything fast path
+    if os.environ.get("BENCH_STREAMING", "1") not in ("0", "false"):
+        try:
+            from lightgbm_tpu.streaming import RowBlockStore, wrap_dataset
+
+            s_rows = min(n_rows, 200_000)
+            push_chunk = 16_384
+            store = RowBlockStore(params=params)
+            t0 = time.perf_counter()
+            for lo in range(0, s_rows, push_chunk):
+                hi = min(s_rows, lo + push_chunk)
+                store.push_rows(X[lo:hi], label=y[lo:hi])
+            core = store.finalize()
+            out["stream_ingest_rows_per_sec"] = round(
+                s_rows / (time.perf_counter() - t0), 1)
+
+            block_rows = max(256, -(-s_rows // 8))
+            budget = 2 * perfmodel.stream_block_bytes(
+                block_rows, core.bins.shape[0], core.bins.dtype.itemsize)
+            saved = {k: os.environ.get(k) for k in
+                     ("LGBM_TPU_HBM_BUDGET", "LGBM_TPU_STREAM_BLOCK_ROWS")}
+            os.environ["LGBM_TPU_HBM_BUDGET"] = str(int(budget))
+            os.environ["LGBM_TPU_STREAM_BLOCK_ROWS"] = str(block_rows)
+            base = {k: int(global_timer.counters.get(k, 0)) for k in
+                    ("stream_h2d_prefetched", "stream_h2d_cold")}
+            try:
+                bs = lgb.Booster(params=params,
+                                 train_set=wrap_dataset(core, params=params))
+                bs.update()  # compile warmup, not timed
+                t0 = time.perf_counter()
+                for _ in range(N_ITERS):
+                    bs.update()
+                stream_s = time.perf_counter() - t0
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+            out["stream_train_rows_per_sec"] = round(
+                s_rows * N_ITERS / stream_s, 1)
+            c = global_timer.counters
+            out["hbm_resident_fraction"] = round(
+                c["stream_resident_blocks"] / c["stream_blocks_total"], 4)
+            pre = int(c.get("stream_h2d_prefetched", 0)
+                      ) - base["stream_h2d_prefetched"]
+            cold = int(c.get("stream_h2d_cold", 0)) - base["stream_h2d_cold"]
+            out["stream_h2d_overlap_pct"] = round(
+                100.0 * pre / max(pre + cold, 1), 2)
+        except Exception as e:  # noqa: BLE001 - secondary must not kill primary
+            out["stream_error"] = repr(e)[:200]
     return out
 
 
@@ -422,7 +477,10 @@ def main() -> None:
                       "serve_batches", "serve_parse_ms_p99",
                       "serve_queue_ms_p99", "serve_assembly_ms_p99",
                       "serve_device_ms_p99", "serve_d2h_ms_p99",
-                      "serve_serialize_ms_p99", "attribution"):
+                      "serve_serialize_ms_p99", "stream_ingest_rows_per_sec",
+                      "stream_train_rows_per_sec", "hbm_resident_fraction",
+                      "stream_h2d_overlap_pct", "stream_error",
+                      "attribution"):
                 if k in res:
                     record[k] = res[k]
             _append_ledger(record)

@@ -45,4 +45,8 @@ def __getattr__(name):
         from . import plotting
 
         return getattr(plotting, name)
+    if name in ("RowBlockStore", "ContinuousTrainer"):
+        from . import streaming
+
+        return getattr(streaming, name)
     raise AttributeError(f"module 'lightgbm_tpu' has no attribute {name!r}")

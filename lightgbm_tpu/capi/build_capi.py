@@ -36,6 +36,17 @@ int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
 int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out);
 int LGBM_DatasetFree(DatasetHandle handle);
 
+int LGBM_DatasetCreateStreaming(int32_t ncol, const char* parameters,
+                                DatasetHandle* out);
+int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                         int data_type, int32_t nrow, int32_t ncol,
+                         int32_t start_row);
+int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int64_t start_row);
+
 int LGBM_BoosterCreate(DatasetHandle train_data, const char* parameters,
                        BoosterHandle* out);
 int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data);
@@ -126,6 +137,9 @@ _bind("LGBM_DatasetSetField", "dataset_set_field")
 _bind("LGBM_DatasetGetNumData", "dataset_get_num_data")
 _bind("LGBM_DatasetGetNumFeature", "dataset_get_num_feature")
 _bind("LGBM_DatasetFree", "dataset_free")
+_bind("LGBM_DatasetCreateStreaming", "dataset_create_streaming")
+_bind("LGBM_DatasetPushRows", "dataset_push_rows")
+_bind("LGBM_DatasetPushRowsByCSR", "dataset_push_rows_by_csr")
 _bind("LGBM_BoosterCreate", "booster_create")
 _bind("LGBM_BoosterAddValidData", "booster_add_valid_data")
 _bind("LGBM_BoosterCreateFromModelfile", "booster_create_from_modelfile")

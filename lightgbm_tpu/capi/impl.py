@@ -131,13 +131,92 @@ def dataset_free(ffi, handle):
     return 0
 
 
+# ---- Streaming dataset ------------------------------------------------------
+# LGBM_DatasetPushRows / LGBM_DatasetPushRowsByCSR parity (c_api.cpp): a
+# streaming handle is a RowBlockStore (streaming/ingest.py), which shares
+# the basic.Dataset duck surface, so LGBM_DatasetSetField /
+# LGBM_DatasetGetNumData / LGBM_DatasetGetNumFeature route through
+# unchanged. LGBM_BoosterCreate finalizes the store into a real Dataset.
+
+def dataset_create_streaming(ffi, ncol, parameters, out):
+    """Open a push-rows dataset. Stands in for the reference's
+    CreateByReference/CreateFromSampledColumn entry points: the bin layout
+    is fitted from the pushed sample prefix instead of a donor dataset."""
+    from ..streaming.ingest import RowBlockStore
+
+    params = _parse_params(ffi.string(parameters).decode())
+    store = RowBlockStore(params=params,
+                          n_features=int(ncol) if int(ncol) > 0 else None)
+    out[0] = _register(store)
+    return 0
+
+
+def _as_store(handle):
+    from ..streaming.ingest import RowBlockStore
+
+    obj = _get(handle)
+    if not isinstance(obj, RowBlockStore):
+        raise TypeError("handle is not a streaming dataset "
+                        "(LGBM_DatasetCreateStreaming)")
+    return obj
+
+
+def _check_start_row(store, start_row) -> None:
+    # the reference writes blocks at arbitrary offsets from parallel
+    # pushers; this port keeps the common sequential contract explicit
+    if int(start_row) != store.total_rows:
+        raise ValueError(
+            f"non-sequential push: start_row={int(start_row)} but "
+            f"{store.total_rows} rows are already pushed")
+
+
+def dataset_push_rows(ffi, handle, data, data_type, nrow, ncol, start_row):
+    store = _as_store(handle)
+    _check_start_row(store, start_row)
+    X = _mat_from_ptr(ffi, data, data_type, nrow, ncol, 1)  # row-major ABI
+    store.push_rows(X)
+    return 0
+
+
+def dataset_push_rows_by_csr(ffi, handle, indptr, indptr_type, indices, data,
+                             data_type, nindptr, nelem, num_col, start_row):
+    store = _as_store(handle)
+    _check_start_row(store, start_row)
+    ip_dt = _DTYPES.get(int(indptr_type))
+    if ip_dt not in (np.int32, np.int64):
+        raise ValueError(f"indptr_type must be int32/int64, got {indptr_type}")
+    ip_buf = ffi.buffer(indptr, int(nindptr) * np.dtype(ip_dt).itemsize)
+    ip = np.frombuffer(ip_buf, dtype=ip_dt).copy()
+    idx_buf = ffi.buffer(indices, int(nelem) * np.dtype(np.int32).itemsize)
+    idx = np.frombuffer(idx_buf, dtype=np.int32).copy()
+    dt = _DTYPES.get(int(data_type))
+    if dt is None:
+        raise ValueError(f"unknown C_API_DTYPE {data_type}")
+    val_buf = ffi.buffer(data, int(nelem) * np.dtype(dt).itemsize)
+    values = np.frombuffer(val_buf, dtype=dt).copy()
+    store.push_csr(ip, idx, values, int(num_col))
+    return 0
+
+
 # ---- Booster ----------------------------------------------------------------
+
+def _as_train_set(obj, params):
+    """A streaming store handed to BoosterCreate finalizes here — the
+    construct-on-first-use moment the reference reaches inside
+    LGBM_BoosterCreate via Dataset::FinishLoad."""
+    from ..streaming.ingest import RowBlockStore
+
+    if isinstance(obj, RowBlockStore):
+        return obj.to_basic_dataset(params=params)
+    return obj
+
 
 def booster_create(ffi, train_data, parameters, out):
     import lightgbm_tpu as lgb
 
     params = _parse_params(ffi.string(parameters).decode())
-    bst = lgb.Booster(params=params, train_set=_get(train_data))
+    bst = lgb.Booster(params=params,
+                      train_set=_as_train_set(_get(train_data), params))
     out[0] = _register(bst)
     return 0
 

@@ -241,6 +241,21 @@ class Dataset:
             self._align_with(reference, data)
             return self
 
+        group_lists = self._fit_layout(data, categorical_feature)
+        self._build_groups_and_bins(group_lists, data)
+        return self
+
+    def _fit_layout(self, data, categorical_feature: Sequence[int] = ()
+                    ) -> List[List[int]]:
+        """Fit the bin layout (per-feature BinMappers, used features, EFB
+        group lists) from `data` WITHOUT binning any rows. Split out of
+        from_matrix so the streaming ingest path (streaming/ingest.py) can
+        fit on a buffered sample prefix and then bin arbitrary row blocks
+        through _bin_rows — concatenated block bins are identical to a
+        one-shot construction over the same layout."""
+        config = self.config
+        n, f = data.shape
+        self.num_total_features = f
         rng = np.random.RandomState(config.data_random_seed)
         sample_cnt = min(config.bin_construct_sample_cnt, n)
         cat_set = set(int(c) for c in categorical_feature)
@@ -299,10 +314,15 @@ class Dataset:
             self.mappers, sample_nonzero_masks, len(sample_idx),
             self.used_features, self.config.max_conflict_rate if hasattr(self.config, "max_conflict_rate") else 0.0,
             enable_bundle=self.config.enable_bundle)
-        self._build_groups_and_bins(group_lists, data)
-        return self
+        return group_lists
 
     def _build_groups_and_bins(self, group_lists: List[List[int]], data: np.ndarray) -> None:
+        self._make_groups(group_lists)
+        self.bins = self._bin_rows(data)
+
+    def _make_groups(self, group_lists: List[List[int]]) -> None:
+        """Materialize FeatureGroups + the feature->(group, member) map from
+        fitted mappers; row-count independent (no bins touched)."""
         self.groups = []
         self.feature_to_group = {}
         for gi, feats in enumerate(group_lists):
@@ -311,16 +331,26 @@ class Dataset:
             self.groups.append(fg)
             for mi, j in enumerate(feats):
                 self.feature_to_group[j] = (gi, mi)
+
+    def bins_dtype(self) -> np.dtype:
         max_bins = max((g.num_total_bin for g in self.groups), default=1)
-        dtype = np.uint8 if max_bins <= 256 else np.uint16
-        self.bins = np.zeros((len(self.groups), self.num_data), dtype=dtype)
+        return np.dtype(np.uint8 if max_bins <= 256 else np.uint16)
+
+    def _bin_rows(self, data) -> np.ndarray:
+        """Bin an arbitrary row matrix against the FITTED layout into a
+        [num_groups, n_rows] plane. Binning is per-row independent, so
+        concatenating per-block planes equals one one-shot plane exactly —
+        the invariant streaming ingest relies on."""
+        n_rows = data.shape[0]
+        dtype = self.bins_dtype()
+        bins = np.zeros((len(self.groups), n_rows), dtype=dtype)
         for gi, fg in enumerate(self.groups):
             if not fg.is_multi:
                 j = fg.feature_indices[0]
-                self.bins[gi] = self.mappers[j].values_to_bins(
+                bins[gi] = self.mappers[j].values_to_bins(
                     _column(data, j)).astype(dtype)
             else:
-                acc = np.zeros(self.num_data, dtype=np.int32)
+                acc = np.zeros(n_rows, dtype=np.int32)
                 for mi, j in enumerate(fg.feature_indices):
                     raw = self.mappers[j].values_to_bins(_column(data, j))
                     gb = fg.bin_for_feature(mi, raw)
@@ -328,7 +358,41 @@ class Dataset:
                     # on conflict the later feature wins (matches bundle
                     # push order semantics)
                     acc = np.where(gb != 0, gb, acc)
-                self.bins[gi] = acc.astype(dtype)
+                bins[gi] = acc.astype(dtype)
+        return bins
+
+    @classmethod
+    def from_layout(cls, layout: "Dataset", bins: np.ndarray, num_data: int,
+                    label=None, weight=None, group=None, init_score=None,
+                    position=None,
+                    feature_names: Optional[Sequence[str]] = None) -> "Dataset":
+        """Assemble a Dataset from a fitted layout prototype plus a
+        pre-binned plane (streaming ingest: RowBlockStore.finalize). The
+        layout's mappers/groups are shared, not copied."""
+        self = cls(layout.config)
+        self.num_data = int(num_data)
+        self.num_total_features = layout.num_total_features
+        self.mappers = layout.mappers
+        self.groups = layout.groups
+        self.feature_to_group = layout.feature_to_group
+        self.used_features = layout.used_features
+        self.monotone_constraints = list(layout.monotone_constraints)
+        self.bins = bins
+        self.metadata = Metadata(self.num_data)
+        if label is not None:
+            self.metadata.set_label(label)
+        if weight is not None:
+            self.metadata.set_weights(weight)
+        if group is not None:
+            self.metadata.set_query(group)
+        if init_score is not None:
+            self.metadata.set_init_score(init_score)
+        if position is not None:
+            self.metadata.set_positions(position)
+        self.feature_names = (list(feature_names) if feature_names
+                              else [f"Column_{i}"
+                                    for i in range(self.num_total_features)])
+        return self
 
     @classmethod
     def load_binary(cls, path: str,

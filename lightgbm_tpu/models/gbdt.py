@@ -456,11 +456,23 @@ class GBDT:
         if tree.is_linear:
             self._add_linear_tree_score(tree, class_id)
             return
-        score = add_tree_to_score(
-            tree, self.train_set, self.tree_learner.bins_dev,
-            self.score[class_id], self._all_rows_padded(), self.num_data,
-            self._depth_bound)
+        score = self._score_tree_rows(tree, self.score[class_id],
+                                      self._all_rows_padded())
         self.score = self.score.at[class_id].set(score)
+
+    def _score_tree_rows(self, tree: Tree, score: jax.Array,
+                         rows_padded: jax.Array) -> jax.Array:
+        """Bin-space tree traversal over padded rows. A streamed learner
+        keeps no device plane (bins_dev is None) — route through its
+        block-sharded traversal, which is bitwise-equal (each valid row
+        scattered exactly once with the identical leaf value)."""
+        learner = self.tree_learner
+        if getattr(learner, "bins_dev", None) is None:
+            return learner.add_tree_to_score_blocked(
+                tree, score, rows_padded, self._depth_bound)
+        return add_tree_to_score(tree, self.train_set, learner.bins_dev,
+                                 score, rows_padded, self.num_data,
+                                 self._depth_bound)
 
     def _multiply_score(self, class_id: int, val: float) -> None:
         """ScoreUpdater::MultiplyScore on train + valid (RF averaging)."""
@@ -506,9 +518,7 @@ class GBDT:
         if bag is not None and self._oob_padded is not None:
             # out-of-bag rows: bin-space tree traversal (the train-time
             # AddPredictionToScore path, gbdt.cpp out_of_bag update)
-            score = add_tree_to_score(
-                tree, self.train_set, self.tree_learner.bins_dev, score,
-                self._oob_padded, self.num_data, self._depth_bound)
+            score = self._score_tree_rows(tree, score, self._oob_padded)
         self.score = self.score.at[class_id].set(score)
 
     def _update_valid_scores(self, tree: Tree, class_id: int) -> None:

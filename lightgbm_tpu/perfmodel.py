@@ -76,6 +76,15 @@ def hist_bytes_per_row(n_groups: int, plane_bytes: int, ch: int = 3) -> int:
     return gp * int(plane_bytes) + int(ch) * 4
 
 
+def stream_block_bytes(block_rows: int, n_groups: int, plane_bytes: int) -> int:
+    """H2D bytes of one streamed bin block (streaming/learner.py): the raw
+    [G, block_rows] slab — ``G * block_rows * plane_bytes``. Transfers copy
+    the unpadded host slab; Mosaic tile padding applies only once the block
+    feeds a kernel, so the G here is the true group count, not
+    plane_groups_padded."""
+    return int(n_groups) * int(block_rows) * int(plane_bytes)
+
+
 def scan_bytes_per_wave(wave_width: int, f_pad: int, max_bins: int,
                         ch: int = 3, pool_bytes: int = 4) -> int:
     """Gain-scan read volume per wave: the cumsum+argmax sweep reads the
@@ -287,6 +296,12 @@ def model_bytes_from_counters(counters: Mapping[str, int]) -> Dict[str, int]:
     ici = int(counters.get("device_ici_bytes_per_wave", 0))
     if ici and waves:
         out["ici"] = ici * waves
+    # out-of-core H2D traffic: the block cache counts every upload's bytes
+    # directly (blocks x stream_block_bytes + per-split group rows), so the
+    # counter IS the model — no waves multiplier
+    h2d = int(counters.get("stream_h2d_bytes", 0))
+    if h2d:
+        out["stream_h2d"] = h2d
     return out
 
 
@@ -331,6 +346,7 @@ def attribution(totals: Mapping[str, float], counters: Mapping[str, int],
         # stages map 1:1 by name
         if stage == "grow_fused":
             comp = dict(model)
+            comp.pop("stream_h2d", None)  # H2D is its own (overlapped) stage
             if comp:
                 entry["model_components_bytes"] = comp
                 m_bytes = sum(comp.values())
@@ -344,6 +360,16 @@ def attribution(totals: Mapping[str, float], counters: Mapping[str, int],
     if total_s > 0.0 and other > 0.0:
         stages["other"] = {"wall_s": round(other, 6),
                            "fraction": round(other / total_s, 6)}
+    if "stream_h2d" in model:
+        # out-of-core block transfer: dispatched behind histogram compute
+        # (streaming/learner.py double buffer), so its wall rides inside
+        # stages already counted — fraction stays 0 and the stage is
+        # excluded from the ~1.0 closure by construction
+        h2d_wall = float(counters.get("stream_h2d_us", 0)) / 1e6
+        entry = {"wall_s": round(h2d_wall, 6), "fraction": 0.0,
+                 "overlapped": True, "model_bytes": model["stream_h2d"]}
+        _add_model_seconds(entry, model["stream_h2d"], h2d_wall, bw)
+        stages["stream_h2d"] = entry
     report: Dict[str, Any] = {
         "schema_version": ATTRIBUTION_SCHEMA_VERSION,
         "total_s": round(total_s, 6),

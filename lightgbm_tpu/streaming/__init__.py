@@ -1,0 +1,31 @@
+"""Out-of-core streaming data engine + continuous-training flywheel.
+
+Three pieces (docs/STREAMING.md):
+
+  * ingest.py     — RowBlockStore: incremental row pushes (numpy blocks,
+                    CSR chunks, chunked CSV/iterator sources, and the
+                    LGBM_DatasetPushRows* C-API shims in capi/impl.py),
+                    binned block-by-block against a BinMapper layout
+                    fitted on a buffered sample prefix.
+  * learner.py    — StreamedTreeLearner: trains with only
+                    LGBM_TPU_HBM_BUDGET bytes of the bin plane device-
+                    resident, double-buffering H2D block transfer against
+                    per-chunk histogram accumulation; bit-identical to
+                    the resident learner on the XLA histogram path.
+  * continuous.py — ContinuousTrainer: periodic refits on freshly pushed
+                    blocks, crash-consistent checkpoints (checkpoint.py),
+                    zero-downtime hot-swap into the serving ModelRegistry.
+"""
+from .continuous import ContinuousTrainer
+from .ingest import RowBlockStore, wrap_dataset
+from .learner import (StreamedTreeLearner, stream_budget_bytes,
+                      streaming_requested)
+
+__all__ = [
+    "ContinuousTrainer",
+    "RowBlockStore",
+    "StreamedTreeLearner",
+    "stream_budget_bytes",
+    "streaming_requested",
+    "wrap_dataset",
+]

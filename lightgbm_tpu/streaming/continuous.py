@@ -1,0 +1,118 @@
+"""Continuous-training flywheel: push -> refit -> checkpoint -> hot-swap.
+
+ContinuousTrainer closes the train->serve loop over a live RowBlockStore:
+
+  * `step()` refits when at least `min_new_rows` rows have landed since
+    the last published model (always on the first call).
+  * Each refit pins a ROW WATERMARK before snapshotting the store, and
+    the watermark survives a mid-refit crash: a retried `refit()` for the
+    same generation finalizes the identical row range even if pushes kept
+    arriving, so the checkpoint-resumed run trains on the exact dataset
+    the crashed run saw — the precondition for bit-identical resume.
+  * Training runs through engine.train with a per-generation
+    checkpoint_callback (checkpoint.py's crash-consistent atomic writer).
+    If the generation's checkpoint file already exists when refit starts,
+    it is handed to engine.train as init_model — the same-command resume
+    path, which subtracts the finished iterations and replays the rest
+    bit-identically.
+  * On success the booster is published into the PR 9 serving
+    ModelRegistry (or a PredictionService, which also re-warms and
+    re-baselines its breaker) — an atomic pointer swap, so concurrent
+    predicts never observe a half-loaded model.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from .. import engine
+from ..checkpoint import checkpoint_callback
+from ..utils.timer import global_timer
+from .. import telemetry
+from ..utils.log import Log
+from .ingest import RowBlockStore, wrap_dataset
+
+
+class ContinuousTrainer:
+    def __init__(self, params: Dict[str, Any], store: RowBlockStore, *,
+                 num_boost_round: int = 20,
+                 min_new_rows: int = 1,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_period: int = 1,
+                 registry=None, service=None,
+                 model_name: str = "live") -> None:
+        self.params = dict(params)
+        self.store = store
+        self.num_boost_round = int(num_boost_round)
+        self.min_new_rows = int(min_new_rows)
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_period = int(checkpoint_period)
+        self.registry = registry
+        self.service = service
+        self.model_name = model_name
+        self.generation = 0
+        self.booster = None
+        self._trained_rows = 0
+        # crash-consistency watermark: rows pinned by an unfinished refit
+        self._inflight_rows: Optional[int] = None
+
+    # ------------------------------------------------------------- refit
+
+    def checkpoint_path(self, generation: Optional[int] = None) -> Optional[str]:
+        if not self.checkpoint_dir:
+            return None
+        gen = self.generation if generation is None else generation
+        return os.path.join(self.checkpoint_dir, f"refit_gen{gen:04d}.txt")
+
+    def step(self):
+        """Refit if enough fresh rows landed; returns the new Booster or
+        None when below the threshold."""
+        fresh = self.store.total_rows - self._trained_rows
+        if self.booster is not None and fresh < self.min_new_rows \
+                and self._inflight_rows is None:
+            return None
+        return self.refit()
+
+    def refit(self):
+        """One generation: snapshot -> train (checkpointed) -> publish."""
+        if self._inflight_rows is None:
+            self._inflight_rows = self.store.total_rows
+        rows = self._inflight_rows
+        core = self.store.finalize(rows)
+        train_set = wrap_dataset(core, params=self.params)
+        callbacks = []
+        init_model = None
+        ckpt = self.checkpoint_path()
+        if ckpt:
+            os.makedirs(self.checkpoint_dir, exist_ok=True)
+            callbacks.append(checkpoint_callback(
+                ckpt, period=self.checkpoint_period))
+            if os.path.exists(ckpt):
+                # a crashed refit of THIS generation left a snapshot:
+                # resume it (engine.train subtracts finished iterations
+                # and replays the remainder bit-identically)
+                init_model = ckpt
+                Log.info("continuous: resuming generation %d from %s",
+                         self.generation, ckpt)
+        with global_timer.scope("stream_refit"):
+            booster = engine.train(
+                self.params, train_set,
+                num_boost_round=self.num_boost_round,
+                init_model=init_model, callbacks=callbacks)
+        self._publish(booster)
+        self.booster = booster
+        self._trained_rows = rows
+        self._inflight_rows = None
+        self.generation += 1
+        global_timer.set_count("stream_generation", self.generation)
+        if telemetry.enabled():
+            telemetry.emit("stream_refit", generation=self.generation,
+                           rows=rows)
+        return booster
+
+    def _publish(self, booster) -> None:
+        """Atomic hot-swap into the serving front (no-op without one)."""
+        if self.service is not None:
+            self.service.load_model(self.model_name, booster=booster)
+        elif self.registry is not None:
+            self.registry.load(self.model_name, booster=booster)

@@ -1,0 +1,297 @@
+"""Streaming ingest: chunked row-block builder behind LGBM_DatasetPushRows*.
+
+RowBlockStore is the counterpart of the reference's streaming dataset
+construction (`LGBM_DatasetCreateByReference` + `LGBM_DatasetPushRows` /
+`LGBM_DatasetPushRowsByCSR`, c_api.cpp): callers push row blocks
+incrementally — numpy matrices, CSR chunks, chunked CSV files, or python
+iterators — and the store produces an io/dataset.py core Dataset without
+ever materializing the raw feature matrix.
+
+Mechanics:
+
+  * Raw blocks buffer on host until `bin_sample_rows` rows have arrived
+    (default: Config.bin_construct_sample_cnt). The bin layout — per-feature
+    BinMappers, used features, EFB group lists — is then fitted once on the
+    buffered prefix via Dataset._fit_layout, after which every block (the
+    buffered ones first, then each new push) is binned immediately through
+    Dataset._bin_rows into a C-contiguous [num_groups, block_rows] plane
+    slab and the raw block is dropped. Peak host memory is the uint8/uint16
+    bin blocks plus one raw block in flight.
+  * Binning is per-row independent, so the concatenated block planes are
+    byte-identical to a one-shot Dataset.from_matrix over the same layout.
+    When total pushed rows stay at or below the sample budget the fitted
+    layout itself matches one-shot construction exactly (the "prefix" is
+    the whole matrix, and the sampling RNG draws identically), so the
+    finalized dataset is indistinguishable from the in-memory build — the
+    equivalence tier-1 tests lock. Past the sample budget, layouts are
+    fitted from the prefix sample rather than a global sample: same shape,
+    slightly different cut points, exactly like the reference's
+    sampled-prefix StreamingDataset contract.
+  * finalize() snapshots the store into a core Dataset (optionally only the
+    first `num_rows` rows — the continuous trainer pins a row watermark so
+    a crash-resumed refit sees the identical dataset even while pushes keep
+    landing). The store stays open for more pushes afterwards.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..io.dataset import Dataset as CoreDataset
+from ..utils.timer import global_timer
+from .. import telemetry
+
+
+def _as_block(data) -> np.ndarray:
+    """Normalize one pushed block to a 2-D float matrix, mirroring
+    from_matrix's dtype rule (f32/f64 kept, everything else -> f64)."""
+    block = np.asarray(data)
+    if block.ndim == 1:
+        block = block.reshape(1, -1)
+    if block.ndim != 2:
+        raise ValueError(f"pushed block must be 2-D, got shape {block.shape}")
+    if block.dtype not in (np.float32, np.float64):
+        block = block.astype(np.float64)
+    return block
+
+
+def _csr_to_dense(indptr: np.ndarray, indices: np.ndarray, values: np.ndarray,
+                  num_col: int) -> np.ndarray:
+    """Densify one CSR chunk (reference PushRowsByCSR semantics: absent
+    entries are 0.0, duplicate column entries keep the last write)."""
+    indptr = np.asarray(indptr, dtype=np.int64)
+    indices = np.asarray(indices, dtype=np.int64)
+    values = np.asarray(values, dtype=np.float64)
+    nrow = len(indptr) - 1
+    block = np.zeros((nrow, int(num_col)), dtype=np.float64)
+    for r in range(nrow):
+        lo, hi = indptr[r], indptr[r + 1]
+        block[r, indices[lo:hi]] = values[lo:hi]
+    return block
+
+
+class RowBlockStore:
+    """Incremental row-block dataset builder (streaming ingest front).
+
+    Thread-safe for one pusher at a time interleaved with finalize() from
+    another thread (the continuous-training flywheel's pattern).
+    """
+
+    def __init__(self, params: Optional[dict] = None,
+                 config: Optional[Config] = None,
+                 n_features: Optional[int] = None,
+                 categorical_feature: Sequence[int] = (),
+                 feature_names: Optional[Sequence[str]] = None,
+                 bin_sample_rows: Optional[int] = None) -> None:
+        self.config = config or Config(dict(params) if params else {})
+        self.n_features = int(n_features) if n_features else None
+        self.categorical_feature = tuple(categorical_feature)
+        self.feature_names = list(feature_names) if feature_names else None
+        self.bin_sample_rows = int(bin_sample_rows
+                                   if bin_sample_rows is not None
+                                   else self.config.bin_construct_sample_cnt)
+        self._lock = threading.RLock()
+        self._raw_blocks: List[np.ndarray] = []      # pre-layout buffer
+        self._raw_labels: List[Optional[np.ndarray]] = []
+        self._bin_blocks: List[np.ndarray] = []      # [G, rows] slabs
+        self._labels: List[Optional[np.ndarray]] = []  # aligned with pushes
+        self._weights: List[Optional[np.ndarray]] = []
+        self._layout: Optional[CoreDataset] = None
+        self.total_rows = 0
+        # full-array metadata overrides (C-API LGBM_DatasetSetField routing)
+        self._field_overrides: dict = {}
+
+    # ------------------------------------------------------------------ push
+
+    def push_rows(self, data, label=None, weight=None) -> "RowBlockStore":
+        """Push one row block. Feature count is pinned by the first push
+        (or the n_features constructor arg — the C-API contract)."""
+        block = _as_block(data)
+        if label is not None:
+            label = np.asarray(label, dtype=np.float64).ravel()
+            if len(label) != block.shape[0]:
+                raise ValueError("label length does not match pushed rows")
+        if weight is not None:
+            weight = np.asarray(weight, dtype=np.float64).ravel()
+            if len(weight) != block.shape[0]:
+                raise ValueError("weight length does not match pushed rows")
+        with self._lock:
+            if self.n_features is None:
+                self.n_features = block.shape[1]
+            elif block.shape[1] != self.n_features:
+                raise ValueError(
+                    f"pushed block has {block.shape[1]} features, "
+                    f"store expects {self.n_features}")
+            self._labels.append(label)
+            self._weights.append(weight)
+            if self._layout is None:
+                self._raw_blocks.append(block)
+                self._buffered = getattr(self, "_buffered", 0) + block.shape[0]
+                if self._buffered >= self.bin_sample_rows:
+                    self._fit_and_drain()
+            else:
+                self._bin_blocks.append(
+                    np.ascontiguousarray(self._layout._bin_rows(block)))
+            self.total_rows += block.shape[0]
+            global_timer.add_count("stream_ingest_rows", block.shape[0])
+            global_timer.add_count("stream_ingest_bytes", block.nbytes)
+        return self
+
+    def push_csr(self, indptr, indices, values, num_col: int,
+                 label=None, weight=None) -> "RowBlockStore":
+        """Push one CSR chunk (LGBM_DatasetPushRowsByCSR parity)."""
+        return self.push_rows(_csr_to_dense(indptr, indices, values, num_col),
+                              label=label, weight=weight)
+
+    def push_csv(self, path: str, chunk_rows: int = 65536,
+                 header: Optional[bool] = None,
+                 label_column: Optional[str] = None) -> "RowBlockStore":
+        """Parse a CSV/TSV file (io/parser.py dialect) and push it in
+        chunk_rows-sized blocks — the file is parsed once, streamed in."""
+        from ..io.parser import parse_file
+
+        X, y, names = parse_file(
+            path,
+            header=self.config.header if header is None else header,
+            label_column=(label_column if label_column is not None
+                          else (self.config.label_column or "0")))
+        if self.feature_names is None and names:
+            self.feature_names = list(names)
+        for lo in range(0, X.shape[0], int(chunk_rows)):
+            hi = min(X.shape[0], lo + int(chunk_rows))
+            self.push_rows(X[lo:hi], label=y[lo:hi] if y is not None else None)
+        return self
+
+    def push_from_iterator(self, blocks: Iterable) -> "RowBlockStore":
+        """Drain an iterator of blocks: each item is either a matrix or an
+        (X, y) tuple. The chunked-iterator source for CI's streaming smoke."""
+        for item in blocks:
+            if isinstance(item, tuple):
+                X, y = item
+                self.push_rows(X, label=y)
+            else:
+                self.push_rows(item)
+        return self
+
+    # ------------------------------------------------- C-API duck surface
+    # (capi/impl.py routes LGBM_Dataset* calls through these so a streaming
+    # handle drops into every shim that expects a basic.Dataset)
+
+    def num_data(self) -> int:
+        return self.total_rows
+
+    def num_feature(self) -> int:
+        return self.n_features or 0
+
+    def set_label(self, label) -> "RowBlockStore":
+        self._field_overrides["label"] = np.asarray(label, dtype=np.float64).ravel()
+        return self
+
+    def set_weight(self, weight) -> "RowBlockStore":
+        self._field_overrides["weight"] = (
+            None if weight is None else np.asarray(weight, dtype=np.float64).ravel())
+        return self
+
+    def set_group(self, group) -> "RowBlockStore":
+        self._field_overrides["group"] = np.asarray(group).ravel()
+        return self
+
+    def set_init_score(self, init_score) -> "RowBlockStore":
+        self._field_overrides["init_score"] = (
+            None if init_score is None else np.asarray(init_score, dtype=np.float64))
+        return self
+
+    def set_position(self, position) -> "RowBlockStore":
+        self._field_overrides["position"] = np.asarray(position).ravel()
+        return self
+
+    # -------------------------------------------------------------- layout
+
+    def _fit_and_drain(self) -> None:
+        """Fit the bin layout on the buffered prefix, then bin and drop
+        every buffered raw block. Called under self._lock."""
+        prefix = (self._raw_blocks[0] if len(self._raw_blocks) == 1
+                  else np.concatenate(self._raw_blocks, axis=0))
+        layout = CoreDataset(self.config)
+        with global_timer.scope("stream_fit_layout"):
+            group_lists = layout._fit_layout(prefix, self.categorical_feature)
+            layout._make_groups(group_lists)
+        self._layout = layout
+        for blk in self._raw_blocks:
+            self._bin_blocks.append(np.ascontiguousarray(layout._bin_rows(blk)))
+        self._raw_blocks = []
+        self._buffered = 0
+        if telemetry.enabled():
+            telemetry.emit("stream_layout_fitted",
+                           sample_rows=int(prefix.shape[0]),
+                           num_groups=len(layout.groups))
+
+    def _require_layout(self) -> CoreDataset:
+        if self._layout is None:
+            if not self._raw_blocks:
+                raise ValueError("RowBlockStore is empty: push rows first")
+            self._fit_and_drain()
+        return self._layout
+
+    def _concat_field(self, name: str, blocks: List[Optional[np.ndarray]],
+                      num_rows: int) -> Optional[np.ndarray]:
+        override = self._field_overrides.get(name)
+        if override is not None:
+            return override[:num_rows] if override.ndim == 1 else override
+        provided = [b for b in blocks if b is not None]
+        if not provided:
+            return None
+        if len(provided) != len(blocks):
+            raise ValueError(
+                f"{name} was provided on some pushes but not others")
+        return np.concatenate(provided)[:num_rows]
+
+    # ------------------------------------------------------------- finalize
+
+    def finalize(self, num_rows: Optional[int] = None) -> CoreDataset:
+        """Snapshot the store into a core io/dataset.py Dataset.
+
+        num_rows pins the snapshot to the first N rows (the continuous
+        trainer's crash-consistent refit watermark); default is every row
+        pushed so far. The store remains open for further pushes."""
+        with self._lock:
+            layout = self._require_layout()
+            n = self.total_rows if num_rows is None else int(num_rows)
+            if n > self.total_rows:
+                raise ValueError(
+                    f"finalize({n}) exceeds pushed rows ({self.total_rows})")
+            plane = (self._bin_blocks[0] if len(self._bin_blocks) == 1
+                     else np.concatenate(self._bin_blocks, axis=1))
+            plane = np.ascontiguousarray(plane[:, :n])
+            label = self._concat_field("label", self._labels, n)
+            weight = self._concat_field("weight", self._weights, n)
+            ds = CoreDataset.from_layout(
+                layout, plane, n, label=label, weight=weight,
+                group=self._field_overrides.get("group"),
+                init_score=self._field_overrides.get("init_score"),
+                position=self._field_overrides.get("position"),
+                feature_names=self.feature_names)
+            global_timer.set_count("stream_finalized_rows", n)
+            return ds
+
+    def to_basic_dataset(self, num_rows: Optional[int] = None,
+                         params: Optional[dict] = None):
+        """finalize() wrapped for Booster/engine consumption."""
+        return wrap_dataset(self.finalize(num_rows), params=params)
+
+
+def wrap_dataset(core: CoreDataset, params: Optional[dict] = None):
+    """Wrap a core Dataset in the lazy basic.Dataset facade (the subset()
+    precedent: hand-set _handle so construct() short-circuits). _raw stays
+    None — streamed datasets keep no raw matrix, so refit()/linear_tree
+    (which need raw feature values) are out of scope for streaming."""
+    from .. import basic
+
+    wrapper = basic.Dataset(None, params=dict(params) if params else None,
+                            free_raw_data=True)
+    wrapper._handle = core
+    wrapper._raw = None
+    return wrapper

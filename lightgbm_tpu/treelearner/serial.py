@@ -642,7 +642,14 @@ def create_tree_learner(learner_type: str, device_type: str, config: Config,
     voting) are built on the parallel backend in parallel/."""
     if learner_type in ("serial",):
         from .device import DeviceTreeLearner
+        # out-of-core: an HBM budget (LGBM_TPU_HBM_BUDGET) means the plane
+        # must NOT be uploaded whole — the streamed learner takes
+        # precedence over device growth (streaming/learner.py)
+        from ..streaming.learner import (StreamedTreeLearner,
+                                         streaming_requested)
 
+        if streaming_requested():
+            return StreamedTreeLearner(config, dataset)
         if device_growth_applies(device_type, config, dataset):
             return DeviceTreeLearner(config, dataset)
         return SerialTreeLearner(config, dataset)

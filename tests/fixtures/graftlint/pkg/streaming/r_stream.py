@@ -1,0 +1,38 @@
+"""Streaming-scope fixture: R1/R6/R9/R10 fire under streaming/ too."""
+from functools import partial
+
+import jax
+import numpy as np
+
+from .. import telemetry
+
+
+@jax.jit  # line 10: VIOLATION jit-donation (array params, nothing donated)
+def block_hist(block: jax.Array, gh: jax.Array):
+    rows = int(gh.sum())  # line 12: VIOLATION jit-host-sync
+    return block.sum() + rows
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def accum(acc: jax.Array, chunk: jax.Array):  # acc donated: clean for R6
+    return acc + chunk.sum()
+
+
+def drive(acc, chunk):
+    out = accum(acc, chunk)
+    host = np.asarray(acc)  # line 23: VIOLATION use-after-donation
+    telemetry.emit("stream_block", n=host.size)  # line 24: VIOLATION R9
+    return out
+
+
+def drive_rebound(acc, chunk):
+    acc = accum(acc, chunk)  # rebinding kills the stale name: clean
+    if telemetry.enabled():
+        telemetry.emit("stream_block", n=0)  # guarded: clean
+    return acc
+
+
+# graftlint: disable=jit-donation -- fixture: cached block reused across leaves
+@jax.jit
+def suppressed_entry(block: jax.Array):
+    return block.sum()

@@ -76,6 +76,15 @@ def test_bench_smoke_cpu(tmp_path):
     assert record["serve_queue_ms_p99"] > 0
     assert record["serve_device_ms_p99"] > 0
     assert record["serve_serialize_ms_p99"] > 0
+    # out-of-core streaming capture: chunked ingest + a 2-blocks-of-8
+    # budget train must both have run and timed; the starved budget means
+    # the resident fraction sits strictly inside (0, 1) and the overlap
+    # percentage is a real ratio (prefetch hits can be 0 on tiny runs)
+    assert "stream_error" not in record, record
+    assert record["stream_ingest_rows_per_sec"] > 0
+    assert record["stream_train_rows_per_sec"] > 0
+    assert 0.0 < record["hbm_resident_fraction"] < 1.0
+    assert 0.0 <= record["stream_h2d_overlap_pct"] <= 100.0
     # provenance: every record carries the environment fingerprint and the
     # ledger schema version (benchdiff refuses cross-schema comparisons)
     assert record["schema_version"] == 1

@@ -173,7 +173,8 @@ def test_r6_donated_scalar_and_unjitted_are_clean(fixture_result):
 
 
 def test_r6_suppression_honored(fixture_result):
-    sup = _hits(fixture_result, "jit-donation", suppressed=True)
+    sup = _hits(fixture_result, "jit-donation", "treelearner/r6_donate.py",
+                suppressed=True)
     assert len(sup) == 1 and "'suppressed'" in sup[0].message
     assert "reused across iterations" in sup[0].reason
 
@@ -262,6 +263,36 @@ def test_r9_recorder_append_is_sanctioned(fixture_result):
              + _hits(fixture_result, "telemetry-hygiene", "tracing.py",
                      suppressed=True)}
     assert not lines & {18, 25, 26, 32}
+
+
+# -- streaming/ scope (R1/R6/R9/R10 cover the out-of-core engine) ---------
+
+def test_streaming_scope_r1_and_r6(fixture_result):
+    r6 = _hits(fixture_result, "jit-donation", "streaming/r_stream.py")
+    assert [v.line for v in r6] == [10]
+    assert "'block_hist'" in r6[0].message
+    r1 = _hits(fixture_result, "jit-host-sync", "streaming/r_stream.py")
+    assert [v.line for v in r1] == [12]
+
+
+def test_streaming_scope_r9_and_r10(fixture_result):
+    r10 = _hits(fixture_result, "use-after-donation",
+                "streaming/r_stream.py")
+    assert [v.line for v in r10] == [23]
+    assert "'acc'" in r10[0].message
+    r9 = _hits(fixture_result, "telemetry-hygiene", "streaming/r_stream.py")
+    assert [v.line for v in r9] == [24]
+
+
+def test_streaming_clean_and_suppressed(fixture_result):
+    # donated accum (17), rebound-name read (29), guarded emit (31): clean
+    lines = {v.line for v in
+             fixture_result.violations + fixture_result.suppressed
+             if v.path == "streaming/r_stream.py"}
+    assert not lines & {17, 29, 31}
+    sup = _hits(fixture_result, "jit-donation", "streaming/r_stream.py",
+                suppressed=True)
+    assert len(sup) == 1 and "reused across leaves" in sup[0].reason
 
 
 # -- S1 directive hygiene -------------------------------------------------

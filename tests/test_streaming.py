@@ -1,0 +1,372 @@
+"""Out-of-core streaming engine: the ISSUE acceptance suite.
+
+Locks the four contracts of docs/STREAMING.md on the CPU tier:
+
+  * streamed-vs-resident bit-identity — the StreamedTreeLearner under a
+    budget 4x smaller than the bin plane (real evictions) and under a
+    budget that fits everything (pin-all) trains byte-identical models to
+    the resident SerialTreeLearner, across plain / bagged / quantized;
+  * push-vs-one-shot equivalence — chunked RowBlockStore ingest (dense,
+    CSR, iterator) finalizes into the same plane/metadata and trains the
+    same model as one-shot construction, including on the 8-virtual-device
+    data-parallel learner;
+  * continuous-training crash consistency — an injected mid-refit kill
+    resumes from the generation checkpoint bit-identically even while new
+    pushes keep landing (the row-watermark contract);
+  * zero-downtime hot-swap — refit generations publish into a live
+    PredictionService under concurrent predict load with zero failures.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.engine import train
+from lightgbm_tpu.io.dataset import Dataset as CoreDataset
+from lightgbm_tpu.streaming import (ContinuousTrainer, RowBlockStore,
+                                    StreamedTreeLearner, wrap_dataset)
+from lightgbm_tpu.streaming.learner import (BLOCK_ROWS_ENV, BUDGET_ENV,
+                                            parse_budget_bytes)
+from lightgbm_tpu.utils import faults
+from lightgbm_tpu.utils.faults import InjectedFault
+
+BASE = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.1,
+        "verbosity": -1, "min_data_in_leaf": 5}
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.clear()
+
+
+def _data(seed=3, n=2048, f=12):
+    rng = np.random.RandomState(seed)
+    X = rng.standard_normal((n, f)).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] + rng.standard_normal(n) * 0.3 > 0)
+    return X, y.astype(np.float64)
+
+
+def _model(params, X, y, rounds=5):
+    return train(dict(params), lgb.Dataset(X, label=y),
+                 num_boost_round=rounds)
+
+
+def _plane_bytes(params, X, y):
+    core = CoreDataset.from_matrix(X, label=y, config=Config(dict(params)))
+    return core.bins.size * core.bins.dtype.itemsize, core.bins.shape[0]
+
+
+# ------------------------------------------------ streamed-vs-resident
+
+@pytest.mark.parametrize("extra", [
+    {},
+    {"bagging_fraction": 0.7, "bagging_freq": 1},
+    {"feature_fraction": 0.8},
+    {"use_quantized_grad": True},
+], ids=["plain", "bagged", "featfrac", "quantized"])
+def test_streamed_bit_identical_starved_budget(monkeypatch, extra):
+    """Budget = 2 blocks of 8 (plane is exactly 4x the budget): the
+    acceptance bound — eviction + prefetch churn must not move a bit."""
+    X, y = _data()
+    params = {**BASE, **extra}
+    resident = _model(params, X, y)
+
+    plane, groups = _plane_bytes(params, X, y)
+    block_bytes = groups * 256  # uint8 plane
+    monkeypatch.setenv(BLOCK_ROWS_ENV, "256")
+    monkeypatch.setenv(BUDGET_ENV, str(2 * block_bytes))
+    assert plane >= 4 * (2 * block_bytes)
+    streamed = _model(params, X, y)
+
+    assert resident.model_to_string() == streamed.model_to_string()
+    np.testing.assert_array_equal(
+        np.asarray(resident.predict(X, raw_score=True)),
+        np.asarray(streamed.predict(X, raw_score=True)))
+
+
+def test_streamed_bit_identical_when_plane_fits(monkeypatch):
+    """A budget covering the whole plane pins every block — same code
+    path, zero evictions, still bit-identical."""
+    X, y = _data(n=1024)
+    resident = _model(BASE, X, y)
+    monkeypatch.setenv(BUDGET_ENV, "1g")
+    streamed = _model(BASE, X, y)
+    assert resident.model_to_string() == streamed.model_to_string()
+
+
+def test_streaming_factory_routing(monkeypatch):
+    X, y = _data(n=512)
+    monkeypatch.setenv(BUDGET_ENV, "64k")
+    bst = lgb.Booster(params=dict(BASE), train_set=lgb.Dataset(X, label=y))
+    learner = bst._gbdt.tree_learner
+    assert isinstance(learner, StreamedTreeLearner)
+    assert learner.bins_dev is None  # the plane never uploads whole
+
+
+def test_parse_budget_bytes():
+    assert parse_budget_bytes("64k") == 64 << 10
+    assert parse_budget_bytes("1.5m") == int(1.5 * (1 << 20))
+    assert parse_budget_bytes("2g") == 2 << 30
+    assert parse_budget_bytes("12345") == 12345
+    assert parse_budget_bytes("") is None
+    assert parse_budget_bytes(None) is None
+    assert parse_budget_bytes("0") is None
+    assert parse_budget_bytes("junk") is None
+
+
+# ------------------------------------------------- push-vs-one-shot
+
+def test_push_rows_matches_one_shot():
+    X, y = _data(n=900)
+    params = dict(BASE)
+    store = RowBlockStore(params=params)
+    for lo in range(0, 900, 256):
+        hi = min(900, lo + 256)
+        store.push_rows(X[lo:hi], label=y[lo:hi])
+    core = store.finalize()
+    oneshot = CoreDataset.from_matrix(X, label=y, config=Config(params))
+    assert np.array_equal(core.bins, oneshot.bins)
+    assert core.num_data == oneshot.num_data
+    assert len(core.groups) == len(oneshot.groups)
+    np.testing.assert_array_equal(np.asarray(core.metadata.label),
+                                  np.asarray(oneshot.metadata.label))
+
+    pushed = train(dict(params), store.to_basic_dataset(params=params),
+                   num_boost_round=5)
+    direct = _model(params, X, y)
+    assert pushed.model_to_string() == direct.model_to_string()
+
+
+def _dense_to_csr(M):
+    indptr, indices, values = [0], [], []
+    for row in M:
+        nz = np.flatnonzero(row)
+        indices.extend(nz.tolist())
+        values.extend(row[nz].tolist())
+        indptr.append(len(indices))
+    return (np.asarray(indptr, np.int64), np.asarray(indices, np.int32),
+            np.asarray(values, np.float64))
+
+
+def test_push_csr_and_iterator_match_dense():
+    X, y = _data(n=600, f=8)
+    dense = RowBlockStore(params=dict(BASE))
+    csr = RowBlockStore(params=dict(BASE))
+    it = RowBlockStore(params=dict(BASE))
+    chunks = [(X[lo:lo + 200], y[lo:lo + 200]) for lo in range(0, 600, 200)]
+    for cx, cy in chunks:
+        dense.push_rows(cx, label=cy)
+        ip, ix, vals = _dense_to_csr(cx.astype(np.float64))
+        csr.push_csr(ip, ix, vals, X.shape[1], label=cy)
+    it.push_from_iterator(iter(chunks))
+    a, b, c = dense.finalize(), csr.finalize(), it.finalize()
+    assert np.array_equal(a.bins, b.bins)
+    assert np.array_equal(a.bins, c.bins)
+    np.testing.assert_array_equal(np.asarray(a.metadata.label),
+                                  np.asarray(b.metadata.label))
+
+
+def test_pushed_dataset_trains_on_sharded_learner():
+    """The finalized streamed dataset drops into the 8-virtual-device
+    data-parallel learner and reproduces the one-shot model exactly."""
+    X, y = _data(n=1024)
+    params = {**BASE, "tree_learner": "data"}
+    store = RowBlockStore(params=params)
+    for lo in range(0, 1024, 300):
+        hi = min(1024, lo + 300)
+        store.push_rows(X[lo:hi], label=y[lo:hi])
+    pushed = train(dict(params), store.to_basic_dataset(params=params),
+                   num_boost_round=4)
+    direct = _model(params, X, y, rounds=4)
+    assert pushed.model_to_string() == direct.model_to_string()
+
+
+def test_push_errors():
+    store = RowBlockStore()
+    store.push_rows(np.zeros((4, 3), np.float32))
+    with pytest.raises(ValueError, match="features"):
+        store.push_rows(np.zeros((4, 5), np.float32))
+    with pytest.raises(ValueError, match="label length"):
+        store.push_rows(np.zeros((4, 3), np.float32), label=np.zeros(3))
+    with pytest.raises(ValueError, match="exceeds pushed rows"):
+        store.finalize(99)
+    empty = RowBlockStore()
+    with pytest.raises(ValueError, match="empty"):
+        empty.finalize()
+
+
+# --------------------------------------------- continuous: crash resume
+
+def test_refit_kill_and_resume_bit_identical(tmp_path):
+    """The flywheel acceptance scenario: a kill mid-refit, new rows still
+    landing, then a retried step() trains the pinned watermark rows from
+    the generation checkpoint — byte-identical to the uninterrupted run."""
+    X, y = _data(n=800)
+    params = dict(BASE)
+
+    def _filled_store():
+        s = RowBlockStore(params=params)
+        for lo in range(0, 600, 200):
+            s.push_rows(X[lo:lo + 200], label=y[lo:lo + 200])
+        return s
+
+    clean = ContinuousTrainer(params, _filled_store(), num_boost_round=6,
+                              checkpoint_dir=str(tmp_path / "clean"))
+    straight = clean.refit()
+
+    crashy_store = _filled_store()
+    crashy = ContinuousTrainer(params, crashy_store, num_boost_round=6,
+                               checkpoint_dir=str(tmp_path / "crashy"))
+    faults.install("kill@3")
+    with pytest.raises(InjectedFault):
+        crashy.step()
+    faults.clear()
+    assert crashy.generation == 0
+    # pushes keep landing while the refit is down — the watermark must
+    # keep the retried generation's dataset pinned to the pre-crash rows
+    crashy_store.push_rows(X[600:800], label=y[600:800])
+    resumed = crashy.step()
+    assert resumed.model_to_string() == straight.model_to_string()
+    assert crashy.generation == 1
+
+    # the NEXT generation picks up the post-crash rows
+    second = crashy.step()
+    assert second is not None
+    assert crashy.generation == 2
+    assert second.model_to_string() != straight.model_to_string()
+
+
+def test_step_noops_below_threshold():
+    X, y = _data(n=400)
+    store = RowBlockStore(params=dict(BASE))
+    store.push_rows(X, label=y)
+    tr = ContinuousTrainer(dict(BASE), store, num_boost_round=2,
+                           min_new_rows=100)
+    assert tr.step() is not None  # first call always fits
+    assert tr.step() is None     # no fresh rows
+    store.push_rows(X[:50], label=y[:50])
+    assert tr.step() is None     # below min_new_rows
+    store.push_rows(X[50:150], label=y[50:150])
+    assert tr.step() is not None
+
+
+# ------------------------------------------------ continuous: hot-swap
+
+def test_refit_hot_swap_zero_failed_predicts():
+    from lightgbm_tpu.serving import PredictionService
+
+    X, y = _data(n=700, f=6)
+    store = RowBlockStore(params=dict(BASE))
+    store.push_rows(X[:300], label=y[:300])
+    svc = PredictionService(max_batch_rows=512, batch_window_s=0.0005)
+    tr = ContinuousTrainer(dict(BASE), store, num_boost_round=3,
+                           service=svc, model_name="live")
+    try:
+        tr.refit()  # publish generation 1 before load starts
+        failures, done = [], threading.Event()
+
+        def hammer():
+            while not done.is_set():
+                try:
+                    out = svc.predict("live", X[:16], raw_score=True)
+                    assert out.shape[0] == 16
+                except Exception as e:  # noqa: BLE001 - the assertion target
+                    failures.append(repr(e))
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for lo in (300, 500):
+            store.push_rows(X[lo:lo + 200], label=y[lo:lo + 200])
+            tr.step()
+        done.set()
+        for t in threads:
+            t.join()
+    finally:
+        svc.close()
+    assert failures == []
+    assert tr.generation == 3
+    assert svc.registry.get("live").version == 3
+
+
+# ------------------------------------------------------- C-API shims
+
+class _FakeFfi:
+    """Just enough of cffi's ffi for capi/impl: zero-copy buffer views
+    over numpy arrays and pass-through byte strings."""
+
+    def buffer(self, obj, size=None):
+        mv = memoryview(obj).cast("B")
+        return mv if size is None else mv[:size]
+
+    def string(self, s):
+        return s if isinstance(s, bytes) else str(s).encode()
+
+
+def test_capi_push_rows_shims():
+    from lightgbm_tpu.capi import impl
+
+    ffi = _FakeFfi()
+    X, y = _data(n=600, f=8)
+    Xd = np.ascontiguousarray(X, dtype=np.float64)
+
+    out = [0]
+    assert impl.dataset_create_streaming(ffi, 0, b"verbosity=-1", out) == 0
+    handle = out[0]
+    try:
+        # dense push (float64 = C_API_DTYPE 1), then a CSR push
+        assert impl.dataset_push_rows(ffi, handle, Xd[:400], 1,
+                                      400, 8, 0) == 0
+        ip, ix, vals = _dense_to_csr(Xd[400:])
+        assert impl.dataset_push_rows_by_csr(
+            ffi, handle, ip, 3, ix, vals, 1, len(ip), len(vals), 8, 400) == 0
+        with pytest.raises(ValueError, match="non-sequential"):
+            impl.dataset_push_rows(ffi, handle, Xd[:400], 1, 400, 8, 0)
+
+        yf = np.asarray(y, dtype=np.float32)
+        assert impl.dataset_set_field(ffi, handle, b"label", yf,
+                                      len(yf), 0) == 0
+        nd, nf = [0], [0]
+        impl.dataset_get_num_data(ffi, handle, nd)
+        impl.dataset_get_num_feature(ffi, handle, nf)
+        assert (nd[0], nf[0]) == (600, 8)
+
+        bout = [0]
+        assert impl.booster_create(
+            ffi, handle,
+            b"objective=binary num_leaves=15 verbosity=-1 num_iterations=3",
+            bout) == 0
+        try:
+            fin = [0]
+            for _ in range(3):
+                impl.booster_update_one_iter(ffi, bout[0], fin)
+            capi_bst = impl._get(bout[0])
+            assert capi_bst.current_iteration() == 3
+
+            # the shim route trains the same bits as the python route
+            store = RowBlockStore(params={"verbosity": -1})
+            store.push_rows(Xd[:400]).push_rows(Xd[400:])
+            store.set_label(yf)
+            direct = train({"objective": "binary", "num_leaves": "15",
+                            "verbosity": "-1", "num_iterations": "3"},
+                           store.to_basic_dataset(), num_boost_round=3)
+            assert capi_bst.model_to_string() == direct.model_to_string()
+        finally:
+            impl.booster_free(ffi, bout[0])
+    finally:
+        impl.dataset_free(ffi, handle)
+
+
+def test_capi_non_streaming_handle_rejected():
+    from lightgbm_tpu.capi import impl
+
+    ffi = _FakeFfi()
+    h = impl._register(object())
+    try:
+        with pytest.raises(TypeError, match="streaming"):
+            impl.dataset_push_rows(ffi, h, np.zeros((1, 2)), 1, 1, 2, 0)
+    finally:
+        impl._free(h)

@@ -73,7 +73,7 @@ class DonationRule(Rule):
     code = "R6"
     description = ("decorator-jitted function with jax.Array parameters "
                    "declares no donate_argnums (inputs get double buffered)")
-    scope_prefixes = ("treelearner/", "models/")
+    scope_prefixes = ("treelearner/", "models/", "streaming/")
 
     def check(self, pkg: Package) -> Iterable[Violation]:
         out: List[Violation] = []

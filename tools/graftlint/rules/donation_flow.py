@@ -86,7 +86,8 @@ class DonationFlowRule(Rule):
     code = "R10"
     description = ("binding read after its buffer was donated to a jit/"
                    "pallas dispatch (donate_argnums / input_output_aliases)")
-    scope_prefixes = ("treelearner/", "models/", "parallel/", "ops/")
+    scope_prefixes = ("treelearner/", "models/", "parallel/", "ops/",
+                      "streaming/")
     whole_program = True
 
     def check(self, pkg: Package) -> Iterable[Violation]:

@@ -85,7 +85,7 @@ class JitBoundaryRule(Rule):
     code = "R1"
     description = ("host sync / numpy escape (int(), .item(), np.asarray, "
                    "...) inside a jax.jit-reachable function")
-    scope_prefixes = ("ops/", "treelearner/")
+    scope_prefixes = ("ops/", "treelearner/", "streaming/")
     scope_exact = ("models/gbdt.py",)
 
     def check(self, pkg: Package) -> Iterable[Violation]:

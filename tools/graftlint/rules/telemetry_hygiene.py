@@ -61,7 +61,7 @@ class TelemetryHygieneRule(Rule):
                    "`if ...enabled...:` guard — payload construction runs "
                    "even with telemetry off (use the counter APIs or guard "
                    "the emission)")
-    scope_prefixes = ("treelearner/", "parallel/", "serving/")
+    scope_prefixes = ("treelearner/", "parallel/", "serving/", "streaming/")
     # perfmodel/exposition sit on the scrape path: a /metrics render or a
     # per-dispatch capture hook runs with telemetry off too, so unguarded
     # emits there cost every caller, not just telemetry users. tracing.py
