@@ -143,6 +143,37 @@ def _wave_traffic_fields(ds) -> dict:
     return fields
 
 
+def _bench_gang_recovery() -> dict:
+    """Measure one detect -> reap -> respawn cycle of the elastic gang
+    supervisor on stub workers (rank 1 exits nonzero on attempt 0; the
+    relaunched gang exits clean). Stubs keep the number a pure supervisor
+    latency — no JAX startup, no coordinator barrier — so regressions in
+    the watch/reap loop itself are visible under the ledger gate."""
+    import subprocess as sp
+
+    from lightgbm_tpu.parallel.elastic import GangSupervisor
+
+    code = ("import sys, time\n"
+            "rank, attempt = int(sys.argv[1]), int(sys.argv[2])\n"
+            "if attempt == 0 and rank == 1:\n"
+            "    sys.exit(7)\n"
+            "time.sleep(0.05)\n")
+
+    def spawn(world, rank, attempt):
+        return sp.Popen([sys.executable, "-c", code, str(rank), str(attempt)])
+
+    try:
+        sup = GangSupervisor(spawn, 4, elastic=True, max_restarts=1,
+                             poll_s=0.02)
+        rc = sup.run()
+        if rc == 0 and sup.last_recovery_ms is not None:
+            return {"gang_recovery_ms": round(sup.last_recovery_ms, 2)}
+        return {"gang_error": f"supervisor rc={rc}, "
+                              f"recovery_ms={sup.last_recovery_ms}"}
+    except Exception as e:  # noqa: BLE001 - secondary must not kill primary
+        return {"gang_error": repr(e)[:200]}
+
+
 def run_bench(n_rows: int) -> dict:
     import lightgbm_tpu as lgb
     from lightgbm_tpu import telemetry
@@ -317,6 +348,23 @@ def run_bench(n_rows: int) -> dict:
                             "health_check_every": 1})
     out["guardrail_overhead_pct"] = round((guard_s / base_s - 1.0) * 100.0, 2)
 
+    # ... and the elastic collective heartbeat at its most aggressive
+    # cadence (the psum health token EVERY iteration; the production
+    # default is every 10th, riding the health monitor's existing sync
+    # slot) vs the same short train with elastic mode off
+    from lightgbm_tpu.parallel import elastic
+
+    elastic.install(timeout_s=None, heartbeat_every=1)
+    try:
+        hb_s = _short_train({})
+    finally:
+        elastic.clear()
+    out["heartbeat_overhead_pct"] = round((hb_s / base_s - 1.0) * 100.0, 2)
+
+    # ... and one elastic gang recovery (detect -> reap -> respawn) on stub
+    # workers, isolating the supervisor's loop latency from JAX startup
+    out.update(_bench_gang_recovery())
+
     # ... and the telemetry stack at full tilt (file sinks + watchers + span
     # capture) vs the same short train with it off — the <1% overhead claim,
     # measured on every capture (can be negative on noisy hosts)
@@ -471,7 +519,8 @@ def main() -> None:
                       "quantized_error", "device_hist_rows",
                       "est_carried_bytes_per_wave", "predict_rows_per_sec",
                       "predict_chunk_rows", "checkpoint_write_ms",
-                      "guardrail_overhead_pct", "compile_count",
+                      "guardrail_overhead_pct", "heartbeat_overhead_pct",
+                      "gang_recovery_ms", "gang_error", "compile_count",
                       "hbm_high_water_bytes", "telemetry_overhead_pct",
                       "serve_rows_per_sec", "serve_p50_ms", "serve_p99_ms",
                       "serve_batches", "serve_parse_ms_p99",

@@ -40,6 +40,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import numpy as np
 
 from . import telemetry
+from .fingerprint import world_fingerprint
 from .utils import faults
 from .utils.log import Log
 from .utils.timer import global_timer
@@ -177,6 +178,16 @@ def save_checkpoint(booster, path: str, retries: int = 3) -> None:
                 else:
                     learner_scalars[k] = v
         health = getattr(gbdt, "_health", None)
+        world = world_fingerprint()
+        if learner is not None and hasattr(learner, "D"):
+            # the in-process mesh can be capped below len(jax.devices())
+            # (num_machines / LGBM_TPU_FORCE_MESH_DEVICES): record the shape
+            # the learner actually sharded over, not the device inventory
+            world["mesh_shape"] = [int(learner.D)]
+        else:
+            # serial learner: nothing is sharded, so the host's device
+            # inventory is irrelevant to restore compatibility
+            world["mesh_shape"] = [1]
         manifest = {
             "version": CKPT_VERSION,
             "iteration": int(gbdt.iter_),
@@ -190,6 +201,7 @@ def save_checkpoint(booster, path: str, retries: int = 3) -> None:
             "learner": learner_scalars,
             "es": getattr(booster, "_early_stop_state", None),
             "health": health.snapshot() if health is not None else None,
+            "world": world,
         }
         buf = io.BytesIO()
         np.savez_compressed(
@@ -312,6 +324,30 @@ def restore_trainer_state(booster, state: TrainerState,
         Log.fatal("Checkpoint valid sets %s do not match the resume call's "
                   "%s (same valid_sets, same order, same names required)",
                   man.get("valid_names"), gbdt.valid_names)
+    saved_world = man.get("world")
+    if saved_world is not None:
+        here = world_fingerprint()
+        learner = getattr(gbdt, "tree_learner", None)
+        if learner is not None and hasattr(learner, "D"):
+            here["mesh_shape"] = [int(learner.D)]
+        else:
+            here["mesh_shape"] = [1]  # mirrors the save-side serial shape
+        keys = ("process_count", "mesh_shape", "device_kinds")
+        if any(saved_world.get(k) != here.get(k) for k in keys):
+            # not fatal: restore re-shards deterministically onto the new
+            # mesh (docs/ROBUSTNESS.md "shrink-to-fit") — but the shapes are
+            # named HERE, not discovered deep in make_array_from_callback,
+            # and float32 runs should expect drift across shard boundaries
+            Log.warning(
+                "Checkpoint was written under world %s but is being "
+                "restored under %s; state will be re-sharded onto the "
+                "current mesh (bit-identity across world sizes holds only "
+                "for quantized histograms — see docs/ROBUSTNESS.md)",
+                {k: saved_world.get(k) for k in keys},
+                {k: here.get(k) for k in keys})
+            telemetry.emit("checkpoint_world_mismatch",
+                           saved=saved_world, current=here,
+                           iteration=int(state.iteration))
     gbdt.models = GBDTModel.from_string(state.model_text).trees
     gbdt.iter_ = int(state.iteration)
     gbdt._async_stub_stop = bool(man.get("async_stub_stop", False))

@@ -69,6 +69,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
         tracing.dump_flight("train_exception")
         raise
     finally:
+        # beats stop legitimately now — the collective watchdog must not
+        # convert post-training silence into a worker loss
+        from .parallel import elastic
+
+        elastic.notify_train_end()
         if own_tel is not None:
             telemetry.stop()
 

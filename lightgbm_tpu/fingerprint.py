@@ -88,6 +88,39 @@ def fingerprint(repo_dir: Optional[str] = None) -> Dict[str, Any]:
     return fp
 
 
+def world_fingerprint() -> Dict[str, Any]:
+    """The distributed-world identity block stamped into checkpoint
+    sidecars (docs/ROBUSTNESS.md, "Distributed fault domain"): enough to
+    name BOTH shapes when a restore lands on a different world than the
+    save. Same contract as fingerprint(): pure observation, never raises,
+    unknown fields degrade to safe defaults."""
+    fp: Dict[str, Any] = {
+        "process_count": 1,
+        "mesh_shape": [1],
+        "device_kinds": ["unknown"],
+        "jax_version": "unknown",
+        "jaxlib_version": "unknown",
+    }
+    try:
+        import jax
+
+        fp["jax_version"] = str(jax.__version__)
+        try:
+            import jaxlib
+
+            fp["jaxlib_version"] = str(jaxlib.__version__)
+        except Exception:
+            pass
+        fp["process_count"] = int(jax.process_count())
+        devs = jax.devices()
+        fp["mesh_shape"] = [len(devs)]
+        fp["device_kinds"] = sorted({str(d.device_kind) for d in devs}) \
+            or ["none"]
+    except Exception:
+        pass
+    return fp
+
+
 def ledger_path(repo_dir: Optional[str] = None) -> Optional[str]:
     """Resolved ledger file path, or None when appends are disabled via
     $BENCH_LEDGER=0/off/empty-string-sentinel."""

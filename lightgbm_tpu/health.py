@@ -117,6 +117,14 @@ class HealthMonitor:
             self._since_sync = 0
             telemetry.emit("health_check", healthy=healthy,
                            policy=self.policy, iteration=int(gbdt.iter_))
+            # the elastic heartbeat rides THIS window: the scalar pull above
+            # already serialized the dispatch stream, so the gang-cardinality
+            # token costs no additional host sync (parallel/elastic.py)
+            from .parallel import elastic
+
+            rt = elastic.active()
+            if rt is not None:
+                rt.heartbeat_sync(int(gbdt.iter_))
             if not healthy:
                 grads, hesses = self._handle(gbdt, grads, hesses)
             elif self.policy == "rollback":

@@ -28,6 +28,7 @@ from ..ops.predict import (PredictorCache, pack_ensemble, predict_dtype,
                            predict_raw, predict_raw_streamed,
                            stream_chunk_rows)
 from ..ops.score import add_tree_to_score
+from ..parallel import elastic
 from ..treelearner import create_tree_learner
 from ..utils import faults, sanitize
 from ..utils.log import Log
@@ -86,8 +87,18 @@ def _colocate(arr: jax.Array, ref: jax.Array) -> jax.Array:
     mesh while the score vector lives on one device; jit refuses to mix the
     two. device_put here is an async transfer — it overlaps the host replay
     just like the copy_to_host_async pulls."""
-    if (isinstance(arr, jax.Array) and isinstance(ref, jax.Array)
-            and arr.sharding.device_set != ref.sharding.device_set):
+    if not (isinstance(arr, jax.Array) and isinstance(ref, jax.Array)):
+        return arr
+    if not arr.is_fully_addressable:
+        # multi-process mesh output: this process only holds its shards, so
+        # device_put cannot assemble the value — allgather the global array
+        # across the gang (every rank calls this in lockstep each iteration)
+        from jax.experimental import multihost_utils
+
+        host = multihost_utils.process_allgather(arr, tiled=True)
+        return jax.device_put(jnp.asarray(host),
+                              next(iter(ref.sharding.device_set)))
+    if arr.sharding.device_set != ref.sharding.device_set:
         return jax.device_put(arr, next(iter(ref.sharding.device_set)))
     return arr
 
@@ -283,7 +294,18 @@ class GBDT:
                        hessians: Optional[np.ndarray] = None) -> bool:
         """Returns True when training should STOP (no more valid splits) —
         matching LGBM_BoosterUpdateOneIter's is_finished flag."""
+        rt = elastic.active()
+        if rt is not None:
+            # beat the collective watchdog + (without a health monitor to
+            # piggyback on) run the windowed heartbeat collective. The beat
+            # precedes the fault hooks: a real worker enters the iteration
+            # alive and blocks INSIDE it, so the last-good count the
+            # watchdog reports equals the completed iterations (= the
+            # snapshot a restarted gang resumes from).
+            rt.on_iteration_start(self.iter_,
+                                  piggyback=self._health is not None)
         faults.check_kill(self.iter_)
+        faults.check_distributed(self.iter_)
         if self._async_stub_stop:
             self._async_stub_stop = False
             Log.warning("Stopped training because there are no more leaves "

@@ -185,8 +185,37 @@ def pad_feature_meta(meta: FeatureMeta, f_pad: int) -> FeatureMeta:
     )
 
 
+def _register_barrier_batching() -> None:
+    # jaxlib (as of 0.4.37) ships no vmap rule for optimization_barrier, but
+    # the device learner vmaps find_best_split over leaves and that path
+    # reaches the threshold_l1 barrier below. The barrier is the identity on
+    # values, so batching is trivial: bind on the batched operands and keep
+    # each operand's batch dim unchanged.
+    try:
+        from jax._src.lax.lax import optimization_barrier_p
+        from jax.interpreters import batching
+    except ImportError:  # future jax: internals moved — assume rule exists
+        return
+    if optimization_barrier_p not in batching.primitive_batchers:
+        def _batcher(args, dims):
+            return optimization_barrier_p.bind(*args), dims
+        batching.primitive_batchers[optimization_barrier_p] = _batcher
+
+
+_register_barrier_batching()
+
+
 def threshold_l1(s, l1):
-    return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
+    # The barrier pins the soft-thresholded gradient to a rounded f32 before
+    # it feeds the output division and the gain products. Without it, XLA's
+    # algebraic rewrite of the fused sign/abs/divide/multiply chain differs
+    # between the inlined single-device lowering and the SPMD-partitioned
+    # >=2-device lowering, and split gains wiggle by one ULP across mesh
+    # sizes — which breaks the shrink-to-fit resume bit-identity contract
+    # (docs/ROBUSTNESS.md). Pinning this one value makes every mesh size
+    # produce identical records.
+    return jax.lax.optimization_barrier(
+        jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0))
 
 
 def leaf_output(sum_grad, sum_hess, l1, l2, max_delta_step):

@@ -106,6 +106,17 @@ def init_distributed(config=None,
                   "list containing this host)")
     Log.info("Joining distributed world: coordinator=%s process %d/%d",
              coordinator_address, process_id, num_processes)
+    on_cpu = (os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+              or getattr(config, "device_type", "") == "cpu")
+    if int(num_processes) > 1 and on_cpu:
+        # the default CPU client has no cross-process collectives ("Multi-
+        # process computations aren't implemented on the CPU backend");
+        # gloo gives the CPU gang real psums — essential for the chaos
+        # harness, harmless for the TPU path (knob only affects CPU)
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 - older jaxlib: knob absent
+            pass
     try:
         # the coordinator join can block for the whole cluster spin-up;
         # make that visible in perf reports

@@ -8,6 +8,8 @@ compatibility) caps the mesh when > 1.
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 
@@ -34,6 +36,11 @@ def data_mesh(num_machines: int = 0) -> jax.sharding.Mesh:
     In a single process, num_machines > 1 selects a sub-mesh of that many
     devices when available (local simulation of a num_machines cluster) and
     falls back to all devices with a warning otherwise.
+
+    ``LGBM_TPU_FORCE_MESH_DEVICES=N`` caps the mesh as a final override —
+    num_machines cannot express the 1-device leg of a shrink-to-fit resume
+    chain (<=1 means "all devices"), so the elastic tests/docs use the env
+    knob to replay a shrunk world inside one process.
     """
     devices = jax.devices()
     n = len(devices)
@@ -52,5 +59,12 @@ def data_mesh(num_machines: int = 0) -> jax.sharding.Mesh:
                 "%d-device mesh (start one process per machine with "
                 "jax.distributed for a real multi-host run)",
                 num_machines, n, n)
+    forced = os.environ.get("LGBM_TPU_FORCE_MESH_DEVICES", "")
+    if forced:
+        try:
+            n = max(1, min(int(forced), n))
+        except ValueError:
+            Log.warning("Ignoring unparseable LGBM_TPU_FORCE_MESH_DEVICES=%r",
+                        forced)
     # graftlint: disable=R1 -- np.array over jax.Device handles lays out the mesh grid; no array data moves, and the mesh is built once per learner, not per iteration
     return jax.sharding.Mesh(np.array(devices[:n]), ("data",))

@@ -25,8 +25,9 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Optional
 
-from .. import engine
+from .. import engine, tracing
 from ..checkpoint import checkpoint_callback
+from ..parallel.elastic import WorkerLostError
 from ..utils.timer import global_timer
 from .. import telemetry
 from ..utils.log import Log
@@ -94,11 +95,33 @@ class ContinuousTrainer:
                 init_model = ckpt
                 Log.info("continuous: resuming generation %d from %s",
                          self.generation, ckpt)
-        with global_timer.scope("stream_refit"):
-            booster = engine.train(
-                self.params, train_set,
-                num_boost_round=self.num_boost_round,
-                init_model=init_model, callbacks=callbacks)
+        try:
+            with global_timer.scope("stream_refit"):
+                booster = engine.train(
+                    self.params, train_set,
+                    num_boost_round=self.num_boost_round,
+                    init_model=init_model, callbacks=callbacks)
+        except WorkerLostError as exc:
+            # a gang peer died mid-refit: roll this generation back to its
+            # pinned checkpoint. The watermark stays pinned and the
+            # generation counter does NOT advance, so the next refit()
+            # resumes the SAME row range from the same-generation snapshot;
+            # serving keeps answering from the last published model the
+            # whole time (nothing was swapped).
+            Log.warning("continuous: worker lost mid-refit of generation "
+                        "%d (rank %d, last good iteration %d); generation "
+                        "rolled back to its pinned checkpoint, serving "
+                        "keeps the last published model", self.generation,
+                        exc.rank, exc.last_good_iteration)
+            tracing.note("stream_refit_worker_lost",
+                         generation=self.generation, rank=exc.rank,
+                         last_good_iteration=exc.last_good_iteration)
+            if telemetry.enabled():
+                telemetry.emit("stream_refit_worker_lost",
+                               generation=self.generation, rank=exc.rank,
+                               last_good_iteration=exc.last_good_iteration)
+            global_timer.add_count("stream_refit_worker_lost", 1)
+            return None
         self._publish(booster)
         self.booster = booster
         self._trained_rows = rows

@@ -20,6 +20,23 @@ programmatically (tests call ``install``/``clear``) or read once from the
 Serving faults (lightgbm_tpu/serving/, docs/SERVING.md) — the dispatch
 counter counts device dispatches through the serving batcher, 1-based:
 
+Distributed faults (lightgbm_tpu/parallel/elastic.py, docs/ROBUSTNESS.md
+"Distributed fault domain") — ranks come from JAX_PROCESS_ID; the kill/hang
+pair fires only on gang attempt 0 (``LGBM_TPU_GANG_ATTEMPT``), so an
+elastic restart that resumes at the fault iteration does not re-die:
+
+    worker_kill@R:K     rank R dies at the START of iteration K — a hard
+                        os._exit under gang supervision (exit code 43,
+                        modelling SIGKILL: no unwind, no atexit), a raised
+                        InjectedFault otherwise
+    worker_hang@R:K     rank R stops participating at iteration K but stays
+                        alive: an interruptible spin that polls the elastic
+                        watchdog — the WorkerLostError conversion path
+    coord_loss@K        the coordinator (rank 0) dies at iteration K —
+                        sugar for worker_kill@0:K
+    slow_worker@R:ms    rank R sleeps `ms` milliseconds at the start of
+                        every iteration (straggler; fires every attempt)
+
     slow_predict@N[:secs]    every device dispatch from the Nth onward
                              sleeps `secs` (default 0.05) before running —
                              the slow-device stand-in that saturates the
@@ -49,6 +66,37 @@ class InjectedFault(RuntimeError):
     (the checkpoint files on disk are all a real kill would leave)."""
 
 
+# exit code an injected worker_kill uses under gang supervision — distinct
+# from real crash codes so the supervisor log names the injection
+EXIT_INJECTED_KILL = 43
+
+
+def _rank() -> int:
+    try:
+        return int(os.environ.get("JAX_PROCESS_ID", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def _gang_attempt() -> int:
+    try:
+        return int(os.environ.get("LGBM_TPU_GANG_ATTEMPT", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def _rank_iter(token: str, prefix: str, value=int):
+    """Parse a ``prefix<rank>:<n>`` token; malformed specs are fatal (a
+    typo'd chaos token silently arming nothing would fake a green run)."""
+    body = token[len(prefix):]
+    try:
+        r, v = body.split(":", 1)
+        return int(r), value(v)
+    except ValueError:
+        Log.fatal("Malformed fault token %r: expected %s<rank>:<n>",
+                  token, prefix)
+
+
 class FaultPlan:
     def __init__(self, spec: str = "", seed: int = 0) -> None:
         self.spec = spec or ""
@@ -59,6 +107,9 @@ class FaultPlan:
         self.write_fails = 0
         self.corrupt_sidecar = False
         self.truncate_model = False
+        self.worker_kill = None   # (rank, iteration)
+        self.worker_hang = None   # (rank, iteration)
+        self.slow_worker = None   # (rank, seconds)
         self.slow_predict_at: Optional[int] = None
         self.slow_predict_s = 0.05
         self.fail_predict_at: Optional[int] = None
@@ -102,6 +153,15 @@ class FaultPlan:
                     self.fail_predict_at = int(body)
             elif token == "model_corrupt_upload":
                 self.corrupt_upload = True
+            elif token.startswith("worker_kill@"):
+                self.worker_kill = _rank_iter(token, "worker_kill@")
+            elif token.startswith("worker_hang@"):
+                self.worker_hang = _rank_iter(token, "worker_hang@")
+            elif token.startswith("coord_loss@"):
+                self.worker_kill = (0, int(token[len("coord_loss@"):]))
+            elif token.startswith("slow_worker@"):
+                r, ms = _rank_iter(token, "slow_worker@", value=float)
+                self.slow_worker = (r, ms / 1e3)
             else:
                 Log.fatal("Unknown fault token %r in fault spec %r",
                           token, self.spec)
@@ -145,6 +205,50 @@ def check_kill(iteration: int) -> None:
     if p.kill_at is not None and iteration == p.kill_at and p.once("kill"):
         _emit_fault("kill", iteration=iteration)
         raise InjectedFault(f"injected fault: kill at iteration {iteration}")
+
+
+def check_distributed(iteration: int) -> None:
+    """Injection point at the start of GBDT.train_one_iter, right after
+    check_kill: the distributed fault family. Kill/hang are gated to gang
+    attempt 0 — a relaunched gang resumes at the fault iteration and must
+    not re-die — while the straggler fires every attempt."""
+    p = _get()
+    if p.worker_kill is None and p.worker_hang is None \
+            and p.slow_worker is None:
+        return
+    rank = _rank()
+    attempt0 = _gang_attempt() == 0
+    if p.slow_worker is not None and rank == p.slow_worker[0]:
+        import time
+
+        _emit_fault("slow_worker", rank=rank, iteration=iteration,
+                    seconds=p.slow_worker[1])
+        time.sleep(p.slow_worker[1])
+    if attempt0 and p.worker_kill is not None \
+            and (rank, iteration) == p.worker_kill \
+            and p.once("worker_kill"):
+        _emit_fault("worker_kill", rank=rank, iteration=iteration)
+        Log.warning("Fault injection: killing rank %d at iteration %d",
+                    rank, iteration)
+        if os.environ.get("LGBM_TPU_GANG"):
+            # SIGKILL semantics: no unwind, no atexit, no flush
+            os._exit(EXIT_INJECTED_KILL)
+        raise InjectedFault(
+            f"injected fault: worker {rank} killed at iteration {iteration}")
+    if attempt0 and p.worker_hang is not None \
+            and (rank, iteration) == p.worker_hang \
+            and p.once("worker_hang"):
+        _emit_fault("worker_hang", rank=rank, iteration=iteration)
+        Log.warning("Fault injection: rank %d hanging at iteration %d "
+                    "(interruptible spin)", rank, iteration)
+        import time
+
+        from ..parallel import elastic
+        while True:
+            time.sleep(0.01)
+            rt = elastic.active()
+            if rt is not None:
+                rt.poll_raise()
 
 
 def maybe_poison_gh(grads, hesses, iteration: int):

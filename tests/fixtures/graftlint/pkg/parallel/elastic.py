@@ -1,0 +1,36 @@
+"""Elastic-scope fixture: R1 covers parallel/elastic.py — the heartbeat
+must ride an existing sync window, not pull per iteration — and R9 keeps
+the watchdog's emit path enabled-guarded."""
+import jax
+
+from .. import telemetry
+
+
+@jax.jit
+def heartbeat_token(x: jax.Array):
+    return x.sum() * 2.0
+
+
+def watchdog_fire(rank):
+    telemetry.emit("worker_lost", rank=rank)  # line 15: VIOLATION R9
+
+
+def heartbeat_per_iteration(xs):
+    alive = 0
+    for x in xs:
+        alive += int(heartbeat_token(x))  # line 21: VIOLATION R1 loop sync
+    return alive
+
+
+def heartbeat_windowed(xs, every=16):
+    alive = 0
+    for i, x in enumerate(xs):
+        if i % every == 0:
+            # graftlint: disable=R1 -- one pull per health window rides the existing sync slot
+            alive = int(heartbeat_token(x))
+    return alive
+
+
+def watchdog_fire_guarded(rank):
+    if telemetry.enabled():
+        telemetry.emit("worker_lost", rank=rank)  # guarded: clean

@@ -295,6 +295,32 @@ def test_streaming_clean_and_suppressed(fixture_result):
     assert len(sup) == 1 and "reused across leaves" in sup[0].reason
 
 
+# -- parallel/elastic.py scope (R1 beat path + R9 watchdog emits) ---------
+
+def test_elastic_scope_r9_watchdog_emit(fixture_result):
+    # the watchdog fire path builds a worker_lost payload: unguarded emit
+    # fires, the enabled-guarded twin stays clean
+    r9 = _hits(fixture_result, "telemetry-hygiene", "parallel/elastic.py")
+    assert [v.line for v in r9] == [15]
+
+
+def test_elastic_scope_r1_per_iteration_heartbeat(fixture_result):
+    # a heartbeat that pulls the token every iteration is exactly the
+    # hot-path host sync the elastic runtime must NOT reintroduce
+    r1 = _hits(fixture_result, "jit-host-sync", "parallel/elastic.py")
+    assert [v.line for v in r1] == [21]
+    assert "serializes the dispatch pipeline" in r1[0].message
+
+
+def test_elastic_scope_windowed_pull_suppressed(fixture_result):
+    # the sanctioned shape — one pull per health window — carries its
+    # reasoned escape hatch; nothing else in the file may be suppressed
+    sup = [v for v in fixture_result.suppressed
+           if v.path == "parallel/elastic.py"]
+    assert [(v.rule, v.line) for v in sup] == [("jit-host-sync", 30)]
+    assert "health window" in sup[0].reason
+
+
 # -- S1 directive hygiene -------------------------------------------------
 
 def test_s1_bad_directives_are_findings(fixture_result):

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Chaos smoke: kill a worker mid-train and prove elastic recovery.
+
+Runs the same 4-process gang twice through ``lightgbm_tpu.launch``:
+
+1. undisturbed -- the reference model;
+2. with ``LGBM_TPU_FAULT=worker_kill@1:3`` under ``--elastic`` -- rank 1
+   hard-exits at iteration 3, the supervisor reaps the gang, dumps a
+   ``flight-gang_worker_lost.json`` postmortem, and relaunches from the
+   latest crash-consistent snapshot.
+
+The smoke passes when the recovered model is BYTE-identical to the
+undisturbed one and the flight dump names the lost rank. The last stdout
+line is a JSON report (CI uploads it as an artifact):
+
+    {"byte_equal": true, "flight_rank": 1, ...}
+
+Usage: python tools/chaos_smoke.py <workdir>
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+NPROC = 4
+DEVICES_PER_PROC = 2
+KILL_TOKEN = "worker_kill@1:3"
+
+
+def _write_dataset(path: str) -> None:
+    import numpy as np
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(600, 4)
+    y = (X[:, 0] - X[:, 1] + 0.2 * rng.randn(600) > 0).astype(np.float64)
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+
+
+def _gang(train_path: str, model_path: str, *, elastic: bool,
+          env_extra: dict) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO
+    env.pop("XLA_FLAGS", None)  # worker_env re-derives the device count
+    env.update(env_extra)
+    cmd = [sys.executable, "-m", "lightgbm_tpu.launch",
+           "-n", str(NPROC), "--devices-per-proc", str(DEVICES_PER_PROC)]
+    if elastic:
+        cmd += ["--elastic", "--max-restarts", "2"]
+    cmd += ["--",
+            f"data={train_path}", "objective=binary", "num_trees=6",
+            "num_leaves=7", "tree_learner=data", "min_data_in_leaf=10",
+            "snapshot_freq=1", f"output_model={model_path}",
+            "device_type=cpu", "verbosity=-1"]
+    return subprocess.run(cmd, env=env, cwd=_REPO, capture_output=True,
+                          text=True, timeout=540)
+
+
+def main(argv) -> int:
+    if len(argv) < 2:
+        print("usage: chaos_smoke.py <workdir>", file=sys.stderr)
+        return 2
+    workdir = os.path.abspath(argv[1])
+    os.makedirs(workdir, exist_ok=True)
+    flight_dir = os.path.join(workdir, "flight")
+    train_path = os.path.join(workdir, "chaos.train")
+    base_model = os.path.join(workdir, "base_model.txt")
+    chaos_model = os.path.join(workdir, "chaos_model.txt")
+    _write_dataset(train_path)
+
+    report = {"nproc": NPROC, "fault": KILL_TOKEN}
+    t0 = time.monotonic()
+    base = _gang(train_path, base_model, elastic=False, env_extra={})
+    report["base_s"] = round(time.monotonic() - t0, 2)
+    if base.returncode != 0:
+        report["error"] = ("undisturbed gang rc=%d\n%s" % (
+            base.returncode, (base.stdout + base.stderr)[-2000:]))
+        print(json.dumps(report))
+        return 1
+
+    t0 = time.monotonic()
+    chaos = _gang(train_path, chaos_model, elastic=True, env_extra={
+        "LGBM_TPU_FAULT": KILL_TOKEN,
+        "LGBM_TPU_FLIGHT_DIR": flight_dir,
+    })
+    report["chaos_s"] = round(time.monotonic() - t0, 2)
+    if chaos.returncode != 0:
+        report["error"] = ("chaos gang rc=%d\n%s" % (
+            chaos.returncode, (chaos.stdout + chaos.stderr)[-2000:]))
+        print(json.dumps(report))
+        return 1
+
+    with open(base_model, "rb") as f:
+        base_bytes = f.read()
+    with open(chaos_model, "rb") as f:
+        chaos_bytes = f.read()
+    report["byte_equal"] = base_bytes == chaos_bytes
+
+    # the supervisor's postmortem must name the lost rank
+    dumps = sorted(glob.glob(
+        os.path.join(flight_dir, "flight-gang_worker_lost*.json")))
+    if dumps:
+        with open(dumps[-1]) as f:
+            payload = json.load(f)
+        extra = payload.get("extra") or {}
+        report["flight_rank"] = extra.get("rank")
+        report["flight_attempt"] = extra.get("attempt")
+        report["flight_path"] = dumps[-1]
+    else:
+        report["flight_rank"] = None
+        report["error"] = f"no gang_worker_lost flight dump in {flight_dir}"
+
+    ok = report.get("byte_equal") is True and report.get("flight_rank") == 1
+    print(json.dumps(report))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

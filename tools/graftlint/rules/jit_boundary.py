@@ -86,7 +86,9 @@ class JitBoundaryRule(Rule):
     description = ("host sync / numpy escape (int(), .item(), np.asarray, "
                    "...) inside a jax.jit-reachable function")
     scope_prefixes = ("ops/", "treelearner/", "streaming/")
-    scope_exact = ("models/gbdt.py",)
+    # elastic.py sits on the per-iteration beat path: a host pull added
+    # there (heartbeat token, watchdog state) costs every training wave
+    scope_exact = ("models/gbdt.py", "parallel/elastic.py")
 
     def check(self, pkg: Package) -> Iterable[Violation]:
         out: List[Violation] = []
