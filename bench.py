@@ -414,8 +414,8 @@ def run_bench(n_rows: int) -> dict:
                 hi = min(s_rows, lo + push_chunk)
                 store.push_rows(X[lo:hi], label=y[lo:hi])
             core = store.finalize()
-            out["stream_ingest_rows_per_sec"] = round(
-                s_rows / (time.perf_counter() - t0), 1)
+            ingest_s = time.perf_counter() - t0
+            out["stream_ingest_rows_per_sec"] = round(s_rows / ingest_s, 1)
 
             block_rows = max(256, -(-s_rows // 8))
             budget = 2 * perfmodel.stream_block_bytes(
@@ -450,6 +450,43 @@ def run_bench(n_rows: int) -> dict:
             cold = int(c.get("stream_h2d_cold", 0)) - base["stream_h2d_cold"]
             out["stream_h2d_overlap_pct"] = round(
                 100.0 * pre / max(pre + cold, 1), 2)
+
+            # drift capture (docs/STREAMING.md "Drift and generation
+            # safety"): the sketch+occupancy tax on ingest, one forced
+            # bin-mapper refresh, and one holdout gate evaluation — the
+            # three costs the <2% overhead contract is priced against
+            d_saved = os.environ.get("LGBM_TPU_DRIFT")
+            os.environ["LGBM_TPU_DRIFT"] = "1"
+            try:
+                dstore = RowBlockStore(params=params)
+                t0 = time.perf_counter()
+                for lo in range(0, s_rows, push_chunk):
+                    hi = min(s_rows, lo + push_chunk)
+                    dstore.push_rows(X[lo:hi], label=y[lo:hi])
+                dstore.finalize()
+                drift_s = time.perf_counter() - t0
+                out["drift_check_overhead_pct"] = round(
+                    (drift_s / ingest_s - 1.0) * 100.0, 2)
+                t0 = time.perf_counter()
+                dstore.maybe_refresh_bins(force=True)
+                out["bin_refresh_ms"] = round(
+                    (time.perf_counter() - t0) * 1000.0, 3)
+            finally:
+                if d_saved is None:
+                    os.environ.pop("LGBM_TPU_DRIFT", None)
+                else:
+                    os.environ["LGBM_TPU_DRIFT"] = d_saved
+
+            from lightgbm_tpu import health as _health
+
+            g_rows = min(4096, s_rows)
+            Xg, yg = X[:g_rows], y[:g_rows]
+            obj = str(params.get("objective", ""))
+            t0 = time.perf_counter()
+            _health.prediction_loss(bs.predict(Xg), yg, obj)
+            _health.prediction_loss(bs.predict(Xg), yg, obj)
+            out["gate_eval_ms"] = round(
+                (time.perf_counter() - t0) * 1000.0, 3)
         except Exception as e:  # noqa: BLE001 - secondary must not kill primary
             out["stream_error"] = repr(e)[:200]
     return out
@@ -528,7 +565,8 @@ def main() -> None:
                       "serve_device_ms_p99", "serve_d2h_ms_p99",
                       "serve_serialize_ms_p99", "stream_ingest_rows_per_sec",
                       "stream_train_rows_per_sec", "hbm_resident_fraction",
-                      "stream_h2d_overlap_pct", "stream_error",
+                      "stream_h2d_overlap_pct", "drift_check_overhead_pct",
+                      "bin_refresh_ms", "gate_eval_ms", "stream_error",
                       "attribution"):
                 if k in res:
                     record[k] = res[k]

@@ -142,9 +142,15 @@ class TrainerState:
 _model_only_warned = False
 
 
-def save_checkpoint(booster, path: str, retries: int = 3) -> None:
+def save_checkpoint(booster, path: str, retries: int = 3,
+                    extra_manifest: Optional[Dict[str, Any]] = None) -> None:
     """Write a crash-consistent snapshot of `booster` (a Booster, or a raw
-    GBDT driver in learner-level tests) to `path` + `path`.ckpt."""
+    GBDT driver in learner-level tests) to `path` + `path`.ckpt.
+
+    extra_manifest merges caller-owned keys into the sidecar manifest
+    (core keys win on collision) — the continuous trainer records its
+    stream generation and the bin-mapper generation there, so a resume
+    can verify it replays against the same mapper the crashed run used."""
     global _model_only_warned
     gbdt = getattr(booster, "_gbdt", booster)
     gbdt._flush_pending()  # a half-grown async tree is not checkpointable
@@ -188,7 +194,8 @@ def save_checkpoint(booster, path: str, retries: int = 3) -> None:
             # serial learner: nothing is sharded, so the host's device
             # inventory is irrelevant to restore compatibility
             world["mesh_shape"] = [1]
-        manifest = {
+        manifest = dict(extra_manifest or {})
+        manifest.update({
             "version": CKPT_VERSION,
             "iteration": int(gbdt.iter_),
             "num_class": int(gbdt.num_class),
@@ -202,7 +209,7 @@ def save_checkpoint(booster, path: str, retries: int = 3) -> None:
             "es": getattr(booster, "_early_stop_state", None),
             "health": health.snapshot() if health is not None else None,
             "world": world,
-        }
+        })
         buf = io.BytesIO()
         np.savez_compressed(
             buf,
@@ -376,7 +383,9 @@ def restore_trainer_state(booster, state: TrainerState,
 # ---------------------------------------------------------------- callback
 
 def checkpoint_callback(path: Union[str, Callable[[int], str]],
-                        period: int = 1, retries: int = 3) -> Callable:
+                        period: int = 1, retries: int = 3,
+                        extra_manifest: Optional[Dict[str, Any]] = None
+                        ) -> Callable:
     """After-iteration callback writing a full crash-consistent snapshot
     every `period` iterations. `path` is a fixed file name or a callable
     mapping the 1-based finished-iteration count to one (the CLI names
@@ -393,7 +402,8 @@ def checkpoint_callback(path: Union[str, Callable[[int], str]],
         if not hasattr(env.model, "_gbdt"):
             return  # CVBooster: per-fold checkpointing has no single state
         target = path(it) if callable(path) else path
-        save_checkpoint(env.model, target, retries=retries)
+        save_checkpoint(env.model, target, retries=retries,
+                        extra_manifest=extra_manifest)
 
     _callback.order = 40
     _callback.before_iteration = False

@@ -8,7 +8,11 @@ train exactly like a live server. Everything renders from the namespaces
 that already exist — `telemetry.signals()` (authoritative for compile
 counts and HBM high-water), `global_timer.counters` (work counters and
 gauges: ICI bytes/wave, device_hist_rows, committed-vs-speculated waves,
-serve queue depth...), and `global_timer.totals`/`counts` (per-stage
+serve queue depth, the drift family: `drift_psi_milli_max` /
+`drift_edge_milli_max` milli-int gauges, `drift_alarms` /
+`bin_refresh_total` / `stream_generation_rejected` counters and the
+`stream_bin_generation` / `stream_generation` gauges from
+streaming/drift.py...), and `global_timer.totals`/`counts` (per-stage
 seconds/calls) — no second bookkeeping layer to drift.
 
 Exposition format 0.0.4 (text/plain). Naming:

@@ -14,6 +14,7 @@ chunk sizes before anything actually fails.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -21,9 +22,10 @@ import numpy as np
 
 from .. import telemetry, tracing
 from ..health import first_nonfinite_column
+from ..utils.log import Log
 from .batcher import MicroBatcher
 from .breaker import CircuitBreaker
-from .errors import InvalidRequest, ServiceClosed
+from .errors import InvalidRequest, ModelNotFound, ServiceClosed
 from .registry import ModelRegistry
 
 
@@ -47,6 +49,13 @@ class PredictionService:
         self._last_signal_poll = 0.0
         self._started = time.monotonic()
         self._closed = False
+        # staged canary rollout (streaming/continuous.py gated publish):
+        # at most one candidate at a time, None when inactive — the predict
+        # hot path pays a single is-None check
+        self._canary: Optional[Dict[str, Any]] = None
+        self._canary_lock = threading.Lock()
+        self._canary_promotions = 0
+        self._canary_rollbacks = 0
 
     # -------------------------------------------------------------- models
 
@@ -83,6 +92,129 @@ class PredictionService:
             b <<= 1
         return buckets
 
+    # -------------------------------------------------------------- canary
+
+    def start_canary(self, name: str, *, fraction: float = 0.1,
+                     promote_after: int = 32, **kwargs: Any) -> Dict[str, Any]:
+        """Stage a candidate model for `name` behind a traffic split: every
+        ~1/fraction-th predict routes to the candidate until it either
+        serves `promote_after` requests with the breaker closed (full
+        swap) or shows pressure (auto-rollback). `kwargs` is the same
+        payload load_model takes (booster= / model_str= / path=); it is
+        kept so promotion replays the exact load. One canary at a time —
+        a newer candidate supersedes (rolls back) the current one."""
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(f"canary fraction must be in (0, 1], "
+                             f"got {fraction}")
+        canary_name = f"{name}!canary"
+        with self._canary_lock:
+            if self._canary is not None:
+                self._resolve_canary_locked(False, "superseded by a newer "
+                                            "candidate")
+            entry = self.registry.load(canary_name, **kwargs)
+            self.warmup(canary_name)
+            self.breaker.rebaseline(telemetry.signals())
+            self._canary = {
+                "model": name,
+                "canary": canary_name,
+                "fraction": float(fraction),
+                "every": max(1, int(round(1.0 / float(fraction)))),
+                "promote_after": int(promote_after),
+                "served": 0,
+                "seen": 0,
+                "payload": dict(kwargs),
+                "version": entry.version,
+            }
+        tracing.note("canary_started", model=name, fraction=float(fraction),
+                     promote_after=int(promote_after))
+        if telemetry.enabled():
+            telemetry.emit("canary_started", model=name,
+                           fraction=float(fraction),
+                           promote_after=int(promote_after))
+        return entry.info()
+
+    def _canary_route(self, model: str):
+        """The canary registry entry when THIS request is the candidate's
+        turn, else None. Breaker pressure observed here rolls the canary
+        back before any further traffic reaches it."""
+        with self._canary_lock:
+            c = self._canary
+            if c is None or c["model"] != model:
+                return None
+            if self.breaker.info()["state"] != "closed":
+                self._resolve_canary_locked(
+                    False, "breaker pressure during canary window")
+                return None
+            c["seen"] += 1
+            if c["seen"] % c["every"] != 0:
+                return None
+            try:
+                return self.registry.get(c["canary"])
+            except ModelNotFound:
+                self._canary = None
+                return None
+
+    def _canary_served(self, model: str) -> None:
+        with self._canary_lock:
+            c = self._canary
+            if c is None or c["model"] != model:
+                return
+            c["served"] += 1
+            if c["served"] >= c["promote_after"] \
+                    and self.breaker.info()["state"] == "closed":
+                self._resolve_canary_locked(True, "served its window clean")
+
+    def resolve_canary(self, promote: bool, reason: str = "") -> bool:
+        """Finish the canary now: promote the candidate to the primary
+        slot, or roll it back and keep serving the current model. Returns
+        False when no canary is active."""
+        with self._canary_lock:
+            return self._resolve_canary_locked(promote, reason)
+
+    def _resolve_canary_locked(self, promote: bool, reason: str) -> bool:
+        c = self._canary
+        if c is None:
+            return False
+        self._canary = None
+        if promote:
+            self.registry.load(c["model"], **c["payload"])
+            self.warmup(c["model"])
+            self.breaker.rebaseline(telemetry.signals())
+            self.registry.unload(c["canary"])
+            self._canary_promotions += 1
+            Log.info("serving: canary for %r promoted after %d canary "
+                     "requests (%s)", c["model"], c["served"], reason)
+            tracing.note("canary_promoted", model=c["model"],
+                         served=c["served"])
+            if telemetry.enabled():
+                telemetry.emit("canary_promoted", model=c["model"],
+                               served=c["served"])
+        else:
+            self.registry.unload(c["canary"])
+            self._canary_rollbacks += 1
+            Log.warning("serving: canary for %r rolled back after %d canary "
+                        "requests: %s; primary keeps serving", c["model"],
+                        c["served"], reason or "unspecified")
+            tracing.note("canary_rolled_back", model=c["model"],
+                         served=c["served"], reason=reason)
+            if telemetry.enabled():
+                telemetry.emit("canary_rolled_back", model=c["model"],
+                               served=c["served"], reason=reason)
+            tracing.dump_flight("canary_rollback")
+        return True
+
+    def canary_info(self) -> Dict[str, Any]:
+        with self._canary_lock:
+            c = self._canary
+            out = {"active": c is not None,
+                   "promoted": self._canary_promotions,
+                   "rolled_back": self._canary_rollbacks}
+            if c is not None:
+                out.update(model=c["model"], fraction=c["fraction"],
+                           served=c["served"],
+                           promote_after=c["promote_after"])
+            return out
+
     # ------------------------------------------------------------- predict
 
     def predict(self, model: str, rows: Any, raw_score: bool = False,
@@ -105,6 +237,22 @@ class PredictionService:
             span.add_stage("parse", time.perf_counter() - t_parse)
             timeout = (timeout_s if timeout_s is not None
                        else self.default_timeout_s)
+            if self._canary is not None:
+                canary_entry = self._canary_route(model)
+                if canary_entry is not None:
+                    try:
+                        out = self.batcher.submit(canary_entry, X, raw_score,
+                                                  timeout, span=span)
+                    except Exception as exc:
+                        # the candidate failed a live request: roll it back
+                        # and answer from the primary — the caller must
+                        # never see a canary-induced failure
+                        self.resolve_canary(
+                            False, f"candidate request failed: {exc}")
+                        return self.batcher.submit(entry, X, raw_score,
+                                                   timeout, span=span)
+                    self._canary_served(model)
+                    return out
             return self.batcher.submit(entry, X, raw_score, timeout,
                                        span=span)
         finally:
@@ -173,7 +321,13 @@ class PredictionService:
         return {"ready": ready, "models": self.registry.names()}
 
     def stats(self) -> Dict[str, Any]:
+        # lazy import: stats() is a cold path and the streaming package
+        # must not load just because a serving facade was constructed
+        from ..streaming import drift as _drift
+
         return {
+            "canary": self.canary_info(),
+            "drift": _drift.latest(),
             "batcher": self.batcher.stats(),
             "breaker": self.breaker.info(),
             "models": self.registry.info(),

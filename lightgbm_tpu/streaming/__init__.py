@@ -1,6 +1,6 @@
 """Out-of-core streaming data engine + continuous-training flywheel.
 
-Three pieces (docs/STREAMING.md):
+Four pieces (docs/STREAMING.md):
 
   * ingest.py     — RowBlockStore: incremental row pushes (numpy blocks,
                     CSR chunks, chunked CSV/iterator sources, and the
@@ -14,15 +14,25 @@ Three pieces (docs/STREAMING.md):
                     the resident learner on the XLA histogram path.
   * continuous.py — ContinuousTrainer: periodic refits on freshly pushed
                     blocks, crash-consistent checkpoints (checkpoint.py),
-                    zero-downtime hot-swap into the serving ModelRegistry.
+                    a holdout quality gate with generation rollback, and
+                    zero-downtime (optionally canaried) hot-swap into the
+                    serving ModelRegistry.
+  * drift.py      — DriftMonitor: per-feature streaming quantile sketches
+                    + bin-occupancy PSI scoring against the binning-time
+                    reference, driving alarms and the scheduled bin-mapper
+                    refresh (LGBM_TPU_DRIFT / LGBM_TPU_BIN_REFRESH_EVERY).
 """
-from .continuous import ContinuousTrainer
+from .continuous import ContinuousTrainer, GenerationRejected
+from .drift import DriftMonitor, QuantileSketch
 from .ingest import RowBlockStore, wrap_dataset
 from .learner import (StreamedTreeLearner, stream_budget_bytes,
                       streaming_requested)
 
 __all__ = [
     "ContinuousTrainer",
+    "DriftMonitor",
+    "GenerationRejected",
+    "QuantileSketch",
     "RowBlockStore",
     "StreamedTreeLearner",
     "stream_budget_bytes",

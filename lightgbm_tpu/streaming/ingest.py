@@ -41,8 +41,11 @@ import numpy as np
 
 from ..config import Config
 from ..io.dataset import Dataset as CoreDataset
+from ..utils import faults
+from ..utils.log import Log
 from ..utils.timer import global_timer
-from .. import telemetry
+from .. import telemetry, tracing
+from . import drift
 
 
 def _as_block(data) -> np.ndarray:
@@ -85,7 +88,8 @@ class RowBlockStore:
                  n_features: Optional[int] = None,
                  categorical_feature: Sequence[int] = (),
                  feature_names: Optional[Sequence[str]] = None,
-                 bin_sample_rows: Optional[int] = None) -> None:
+                 bin_sample_rows: Optional[int] = None,
+                 holdout_rows: int = 0) -> None:
         self.config = config or Config(dict(params) if params else {})
         self.n_features = int(n_features) if n_features else None
         self.categorical_feature = tuple(categorical_feature)
@@ -103,6 +107,20 @@ class RowBlockStore:
         self.total_rows = 0
         # full-array metadata overrides (C-API LGBM_DatasetSetField routing)
         self._field_overrides: dict = {}
+        # drift detection (None unless LGBM_TPU_DRIFT is on: the hot push
+        # path then pays exactly one is-None check)
+        self._drift = drift.DriftMonitor.from_env(
+            self.config, self.categorical_feature)
+        # group composition pinned at the prefix fit; a bin refresh refits
+        # cut points but keeps the EFB bundles (history can't re-conflict)
+        self._group_lists: Optional[List[List[int]]] = None
+        # bin layout generation: bumped by every maybe_refresh_bins swap,
+        # recorded in checkpoint sidecars for resume verification
+        self.layout_generation = 0
+        # raw tail ring for the publish quality gate's pinned holdout
+        self.holdout_rows = int(holdout_rows)
+        self._tail: List[tuple] = []   # (raw block, label) most-recent-last
+        self._tail_n = 0
 
     # ------------------------------------------------------------------ push
 
@@ -125,6 +143,16 @@ class RowBlockStore:
                 raise ValueError(
                     f"pushed block has {block.shape[1]} features, "
                     f"store expects {self.n_features}")
+            block = faults.maybe_shift_block(block, self.total_rows)
+            if self._drift is not None:
+                self._drift.observe(block, self._layout)
+            if self.holdout_rows > 0:
+                self._tail.append((block, label))
+                self._tail_n += block.shape[0]
+                while self._tail and \
+                        self._tail_n - self._tail[0][0].shape[0] \
+                        >= self.holdout_rows:
+                    self._tail_n -= self._tail.pop(0)[0].shape[0]
             self._labels.append(label)
             self._weights.append(weight)
             if self._layout is None:
@@ -215,11 +243,19 @@ class RowBlockStore:
         every buffered raw block. Called under self._lock."""
         prefix = (self._raw_blocks[0] if len(self._raw_blocks) == 1
                   else np.concatenate(self._raw_blocks, axis=0))
+        # the last block can overshoot the sample budget; fit on EXACTLY
+        # bin_sample_rows rows so the cut points depend only on the pushed
+        # row sequence, never on how callers chunked it (the overshoot rows
+        # still get binned below — only the fit sample is clipped)
+        prefix = prefix[:self.bin_sample_rows]
         layout = CoreDataset(self.config)
         with global_timer.scope("stream_fit_layout"):
             group_lists = layout._fit_layout(prefix, self.categorical_feature)
             layout._make_groups(group_lists)
         self._layout = layout
+        self._group_lists = group_lists
+        if self._drift is not None:
+            self._drift.set_reference(layout, prefix)
         for blk in self._raw_blocks:
             self._bin_blocks.append(np.ascontiguousarray(layout._bin_rows(blk)))
         self._raw_blocks = []
@@ -281,6 +317,92 @@ class RowBlockStore:
                          params: Optional[dict] = None):
         """finalize() wrapped for Booster/engine consumption."""
         return wrap_dataset(self.finalize(num_rows), params=params)
+
+    # ----------------------------------------------- drift / bin refresh
+
+    def holdout_snapshot(self):
+        """(X, y) of the most recent `holdout_rows` pushed rows (raw
+        values, not bins) for the publish quality gate, or None when the
+        tail ring is empty or any tail push lacked labels."""
+        with self._lock:
+            if not self._tail:
+                return None
+            if any(lbl is None for _, lbl in self._tail):
+                return None
+            X = np.concatenate([b for b, _ in self._tail], axis=0)
+            y = np.concatenate([lbl for _, lbl in self._tail])
+            if X.shape[0] > self.holdout_rows:
+                X = X[-self.holdout_rows:]
+                y = y[-self.holdout_rows:]
+            return X, y
+
+    def maybe_refresh_bins(self, force: bool = False) -> bool:
+        """Refit the bin-mapper cut points from the drift sketches and
+        remap every binned slab through old-bin -> new-bin LUTs, as one
+        measured event. Runs when the drift monitor has latched an alarm
+        (or unconditionally under `force`); returns True when a refresh
+        happened.
+
+        The EFB group composition is pinned (binned history cannot be
+        re-checked for conflicts), so only cut points move: every group
+        plane is rewritten via its LUT, the monitor re-anchors its
+        occupancy baseline on the new mappers, and `layout_generation`
+        bumps — the value checkpoint sidecars carry so a resumed refit can
+        verify it replays against the mapper generation it trained under.
+        Published models never notice: tree thresholds are real-valued at
+        the model surface (BinMapper.bin_to_value), not bin indices.
+        """
+        with self._lock:
+            mon = self._drift
+            if mon is None or self._layout is None \
+                    or self._group_lists is None:
+                return False
+            if not force and not mon.alarmed:
+                return False
+            with global_timer.scope("stream_bin_refresh"):
+                old = self._layout
+                new = CoreDataset(self.config)
+                new.num_total_features = old.num_total_features
+                new.monotone_constraints = list(old.monotone_constraints)
+                new.used_features = list(old.used_features)
+                refreshed = 0
+                new.mappers = []
+                for j, mapper in enumerate(old.mappers):
+                    nm = mon.refit_mapper(j, mapper)
+                    if nm is None:
+                        new.mappers.append(mapper)
+                    else:
+                        new.mappers.append(nm)
+                        refreshed += 1
+                if refreshed == 0:
+                    return False
+                new._make_groups(self._group_lists)
+                dtype = new.bins_dtype()
+                luts = [drift.group_bin_lut(og, ng).astype(dtype)
+                        for og, ng in zip(old.groups, new.groups)]
+                remapped = []
+                for blk in self._bin_blocks:
+                    out = np.empty(blk.shape, dtype=dtype)
+                    for gi, lut in enumerate(luts):
+                        out[gi] = lut[blk[gi]]
+                    remapped.append(out)
+                self._bin_blocks = remapped
+                self._layout = new
+                self.layout_generation += 1
+                mon.after_refresh(new)
+            global_timer.add_count("bin_refresh_total", 1)
+            global_timer.set_count("stream_bin_generation",
+                                   self.layout_generation)
+            Log.info("streaming: bin refresh %d refitted %d/%d mappers "
+                     "from drift sketches", self.layout_generation,
+                     refreshed, len(new.mappers))
+            tracing.note("bin_refresh", generation=self.layout_generation,
+                         refreshed=refreshed)
+            if telemetry.enabled():
+                telemetry.emit("bin_refresh",
+                               generation=self.layout_generation,
+                               refreshed=refreshed)
+            return True
 
 
 def wrap_dataset(core: CoreDataset, params: Optional[dict] = None):

@@ -48,6 +48,24 @@ elastic restart that resumes at the fault iteration does not re-die:
                              registry verifies it (one-shot) — the checksum
                              gate must reject it and keep the prior version
 
+Drift / continuous-training faults (lightgbm_tpu/streaming/drift.py,
+docs/STREAMING.md "Drift and generation safety"):
+
+    drift_shift@K:F     every pushed row with absolute index >= K gets
+                        feature F affinely shifted (x*3 + 10) out of the
+                        fitted bin support — a planted covariate shift the
+                        drift monitor must alarm on and a bin refresh must
+                        re-resolve (fires continuously; emits once)
+    bad_generation@G    the refit that would publish generation G has its
+                        trained model poisoned in memory (leaf values
+                        sign-flipped and scaled 1e6) AFTER training and checkpointing — a
+                        genuinely bad candidate only the quality gate can
+                        stop from reaching serving (one-shot)
+    sketch_corrupt@K    plant non-finite garbage inside feature K's
+                        quantile sketch — the next bin refresh must detect
+                        it via the sketch health check and keep the
+                        feature's current cut points (one-shot)
+
 Every injection is one-shot (``kill@K`` fires once even if iteration K is
 re-entered after a rollback) and seeded, so a failing fault test replays
 exactly. All hooks are cheap no-ops when no plan is armed — the boosting
@@ -115,6 +133,9 @@ class FaultPlan:
         self.fail_predict_at: Optional[int] = None
         self.fail_predict_count = 3
         self.corrupt_upload = False
+        self.drift_shift = None       # (start_row, feature)
+        self.bad_generation: Optional[int] = None
+        self.sketch_corrupt: Optional[int] = None
         self._dispatch_no = 0  # serving device-dispatch counter (1-based)
         self._fired = set()
         for token in (t.strip() for t in self.spec.split(",")):
@@ -162,6 +183,12 @@ class FaultPlan:
             elif token.startswith("slow_worker@"):
                 r, ms = _rank_iter(token, "slow_worker@", value=float)
                 self.slow_worker = (r, ms / 1e3)
+            elif token.startswith("drift_shift@"):
+                self.drift_shift = _rank_iter(token, "drift_shift@")
+            elif token.startswith("bad_generation@"):
+                self.bad_generation = int(token[len("bad_generation@"):])
+            elif token.startswith("sketch_corrupt@"):
+                self.sketch_corrupt = int(token[len("sketch_corrupt@"):])
             else:
                 Log.fatal("Unknown fault token %r in fault spec %r",
                           token, self.spec)
@@ -341,6 +368,69 @@ def maybe_corrupt_upload(text: str) -> str:
     Log.warning("Fault injection: corrupted staged model upload "
                 "(%d chars garbled)", min(64, len(text) - mid))
     return text[:mid] + "#" * min(64, len(text) - mid) + text[mid + 64:]
+
+
+def maybe_shift_block(block, start_row: int):
+    """Injection point at the top of RowBlockStore.push_rows: apply the
+    planted covariate shift to every row whose absolute index is at or
+    past the armed threshold. Continuous (drift must persist across
+    checks), but the telemetry record is one-shot."""
+    p = _get()
+    if p.drift_shift is None:
+        return block
+    at, feat = p.drift_shift
+    end_row = start_row + block.shape[0]
+    if end_row <= at or feat >= block.shape[1]:
+        return block
+    import numpy as np
+
+    block = np.array(block, copy=True)  # graftlint: disable=jit-host-sync-xmod -- pushed blocks are host numpy already; the copy keeps the caller's array unshifted
+    lo = max(0, at - start_row)
+    block[lo:, feat] = block[lo:, feat] * 3.0 + 10.0
+    if p.once("drift_shift"):
+        Log.warning("Fault injection: shifting feature %d out of bin "
+                    "support from row %d onward", feat, at)
+        _emit_fault("drift_shift", feature=feat, start_row=at)
+    return block
+
+
+def maybe_poison_generation(booster, generation: int):
+    """Injection point after a refit trains (and checkpoints) generation G:
+    rebuild the booster from model text with every leaf value sign-flipped and scaled 1e6 —
+    a genuinely broken candidate that only the publish quality gate stands
+    between and live traffic. In-memory only: the on-disk checkpoint keeps
+    the good model, so the retry after rejection republishes clean."""
+    p = _get()
+    if p.bad_generation is None or generation != p.bad_generation \
+            or not p.once("bad_generation"):
+        return booster
+    import re
+
+    from .. import basic
+
+    Log.warning("Fault injection: poisoning the trained model for "
+                "generation %d (leaf values sign-flipped and scaled 1e6)", generation)
+    _emit_fault("bad_generation", generation=generation)
+    txt = booster.model_to_string()
+    poisoned = re.sub(
+        r"^leaf_value=(.*)$",
+        lambda m: "leaf_value=" + " ".join(
+            repr(float(v) * -1e6) for v in m.group(1).split()),
+        txt, flags=re.M)
+    return basic.Booster(model_str=poisoned)
+
+
+def sketch_corrupt_feature() -> Optional[int]:
+    """Injection point in the drift monitor's scoring pass: returns the
+    feature index whose sketch should be poisoned with non-finite garbage
+    (one-shot), or None."""
+    p = _get()
+    if p.sketch_corrupt is None or not p.once("sketch_corrupt"):
+        return None
+    Log.warning("Fault injection: corrupting the quantile sketch for "
+                "feature %d", p.sketch_corrupt)
+    _emit_fault("sketch_corrupt", feature=p.sketch_corrupt)
+    return p.sketch_corrupt
 
 
 def _emit_fault(kind: str, **fields) -> None:

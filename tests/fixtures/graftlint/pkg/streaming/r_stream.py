@@ -36,3 +36,16 @@ def drive_rebound(acc, chunk):
 @jax.jit
 def suppressed_entry(block: jax.Array):
     return block.sum()
+
+
+def push_sketch(sketch, block):
+    sketch.update(block)
+    telemetry.emit("sketch_block", rows=block.shape[0])  # line 43: VIOLATION R9 (hot-path sketch emit)
+    return sketch
+
+
+def push_sketch_guarded(sketch, block):
+    sketch.update(block)
+    if telemetry.enabled():
+        telemetry.emit("sketch_block", rows=block.shape[0])  # guarded: clean
+    return sketch

@@ -93,6 +93,13 @@ def test_bench_smoke_cpu(tmp_path):
     assert record["stream_train_rows_per_sec"] > 0
     assert 0.0 < record["hbm_resident_fraction"] < 1.0
     assert 0.0 <= record["stream_h2d_overlap_pct"] <= 100.0
+    # drift-layer cost tracking (docs/STREAMING.md "Drift and generation
+    # safety"): the sketch+occupancy ingest delta is measured every capture
+    # (noisy hosts -> negative is fine), and one forced bin-mapper refresh
+    # plus one holdout gate evaluation both ran and timed
+    assert isinstance(record["drift_check_overhead_pct"], float)
+    assert record["bin_refresh_ms"] > 0
+    assert record["gate_eval_ms"] > 0
     # provenance: every record carries the environment fingerprint and the
     # ledger schema version (benchdiff refuses cross-schema comparisons)
     assert record["schema_version"] == 1

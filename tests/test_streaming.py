@@ -292,6 +292,384 @@ def test_refit_hot_swap_zero_failed_predicts():
     assert svc.registry.get("live").version == 3
 
 
+# --------------------------------------- drift, refresh, publish gate
+
+def _binary_chunks(seed=3, n=2048, f=8, shift_from=None, shift_feature=0):
+    """Deterministic labelled chunks; rows >= shift_from get the feature
+    pushed out of the fitted bin support (the drift scenario)."""
+    rng = np.random.RandomState(seed)
+    X = rng.standard_normal((n, f))
+    y = (X[:, 1] + 0.3 * X[:, 2] > 0).astype(np.float64)
+    if shift_from is not None:
+        X[shift_from:, shift_feature] = \
+            X[shift_from:, shift_feature] * 3.0 + 10.0
+    return X, y
+
+
+def test_refit_event_generation_matches_sidecar(tmp_path):
+    """Satellite regression: the stream_refit event and stream_generation
+    gauge must name the generation the model was checkpointed and
+    published as (emit first, bump after)."""
+    from lightgbm_tpu import telemetry
+    from lightgbm_tpu.checkpoint import read_sidecar_manifest
+    from lightgbm_tpu.utils.timer import global_timer
+
+    X, y = _data(n=512)
+    store = RowBlockStore(params=dict(BASE))
+    store.push_rows(X, label=y)
+    tr = ContinuousTrainer(dict(BASE), store, num_boost_round=2,
+                           checkpoint_dir=str(tmp_path))
+    with telemetry.capture(None, label="gen-test",
+                           watch_compiles=False) as sess:
+        assert tr.step() is not None
+    events = [e for e in sess.events if e.get("ev") == "stream_refit"]
+    assert len(events) == 1
+    manifest = read_sidecar_manifest(tr.checkpoint_path(0))
+    assert manifest is not None
+    assert events[0]["generation"] == manifest["stream_generation"] == 0
+    assert global_timer.counters["stream_generation"] == 0
+    assert tr.generation == 1  # the attribute still counts completed refits
+
+
+@pytest.mark.parametrize("source", ["dense64", "dense256", "dense_whole",
+                                    "csr", "iterator"])
+def test_layout_prefix_deterministic_across_chunkings(source):
+    """Identical pushed rows must fit identical cut points no matter how
+    callers chunk them — the fit prefix is clipped to exactly
+    bin_sample_rows, never the last block's overshoot."""
+    X, y = _data(n=1500, f=6)
+    Xd = X.astype(np.float64)
+    Xd[Xd < -2.2] = 0.0  # sparse tail so CSR roundtrips exercise zeros
+
+    def _store():
+        return RowBlockStore(params=dict(BASE), bin_sample_rows=1000)
+
+    baseline = _store()
+    baseline.push_rows(Xd, label=y)
+    base_cuts = [tuple(m.bin_upper_bound) for m in baseline._layout.mappers]
+    assert baseline._layout is not None  # 1500 pushed > 1000 budget
+
+    store = _store()
+    if source == "dense64":
+        for lo in range(0, 1500, 64):
+            store.push_rows(Xd[lo:lo + 64], label=y[lo:lo + 64])
+    elif source == "dense256":
+        for lo in range(0, 1500, 256):
+            store.push_rows(Xd[lo:lo + 256], label=y[lo:lo + 256])
+    elif source == "dense_whole":
+        store.push_rows(Xd, label=y)
+    elif source == "csr":
+        for lo in range(0, 1500, 300):
+            ip, ix, vals = _dense_to_csr(Xd[lo:lo + 300])
+            store.push_csr(ip, ix, vals, 6, label=y[lo:lo + 300])
+    else:
+        store.push_from_iterator(
+            (Xd[lo:lo + 200], y[lo:lo + 200]) for lo in range(0, 1500, 200))
+    cuts = [tuple(m.bin_upper_bound) for m in store._layout.mappers]
+    assert cuts == base_cuts
+    assert np.array_equal(store.finalize().bins, baseline.finalize().bins)
+
+
+def test_drift_alarm_refresh_restores_resolution(monkeypatch, tmp_path):
+    """Chaos acceptance (detection + refresh): a planted drift_shift must
+    trip the PSI alarm with a flight dump, and the sketch-driven bin
+    refresh must measurably restore bin resolution on the shifted feature
+    while previously published models stay byte-identical."""
+    from lightgbm_tpu.streaming import drift
+    from lightgbm_tpu.utils.timer import global_timer
+
+    monkeypatch.setenv("LGBM_TPU_DRIFT", "1")
+    monkeypatch.setenv("LGBM_TPU_DRIFT_CHECK_ROWS", "512")
+    monkeypatch.setenv("LGBM_TPU_FLIGHT_DIR", str(tmp_path))
+    faults.install("drift_shift@1024:0")
+    X, y = _binary_chunks(n=3072)
+    store = RowBlockStore(params=dict(BASE), bin_sample_rows=1024)
+    tr = ContinuousTrainer(dict(BASE), store, num_boost_round=3)
+    store.push_rows(X[:1024], label=y[:1024])
+    published = tr.step()
+    old_text = published.model_to_string()
+    old_preds = np.asarray(published.predict(X[:256], raw_score=True))
+
+    alarms_before = global_timer.counters.get("drift_alarms", 0)
+    for lo in range(1024, 3072, 256):
+        store.push_rows(X[lo:lo + 256], label=y[lo:lo + 256])
+    mon = store._drift
+    assert mon is not None and mon.alarmed
+    assert mon.alarm_feature == 0
+    assert global_timer.counters["drift_alarms"] == alarms_before + 1
+    assert (tmp_path / "flight-drift_alarm.json").exists()
+    assert drift.latest()["max_psi"] >= mon.threshold
+
+    # resolution on the shifted regime before vs after the refresh: the
+    # shifted values crowd the top edge bin under the old cut points
+    shifted = X[1024:2048, 0] * 3.0 + 10.0  # what the fault made of them
+    old_bins = store._layout.mappers[0].values_to_bins(shifted)
+    distinct_before = len(np.unique(old_bins))
+    assert store.maybe_refresh_bins() is True
+    assert store.layout_generation == 1
+    assert not mon.alarmed  # refresh re-anchors the baseline
+    new_bins = store._layout.mappers[0].values_to_bins(shifted)
+    distinct_after = len(np.unique(new_bins))
+    assert distinct_after > 4 * max(distinct_before, 1)
+    top = store._layout.mappers[0].num_bin - 1
+    assert (new_bins >= top - 1).mean() < 0.2
+
+    # the published model is untouched by the refresh: thresholds are
+    # real-valued at the model surface, so bits AND predictions hold
+    assert published.model_to_string() == old_text
+    np.testing.assert_array_equal(
+        np.asarray(published.predict(X[:256], raw_score=True)), old_preds)
+
+    # the next generation trains against the refreshed mapper cleanly
+    assert tr.step() is not None
+    assert tr.generation == 2
+
+
+def test_drift_shift_chaos_end_to_end_trainer_refresh(monkeypatch, tmp_path):
+    """The scheduled-refresh path: LGBM_TPU_BIN_REFRESH_EVERY drives
+    maybe_refresh_bins at a fresh generation boundary inside step(), and
+    the post-refresh generation checkpoint records the mapper generation."""
+    from lightgbm_tpu.checkpoint import read_sidecar_manifest
+
+    monkeypatch.setenv("LGBM_TPU_DRIFT", "1")
+    X, y = _binary_chunks(n=2048)
+    store = RowBlockStore(params=dict(BASE), bin_sample_rows=512)
+    tr = ContinuousTrainer(dict(BASE), store, num_boost_round=2,
+                           checkpoint_dir=str(tmp_path), refresh_every=1)
+    store.push_rows(X[:1024], label=y[:1024])
+    assert tr.step() is not None
+    man0 = read_sidecar_manifest(tr.checkpoint_path(0))
+    assert man0["bin_mapper_generation"] == 0
+    store.push_rows(X[1024:], label=y[1024:])
+    assert tr.step() is not None  # gen 1: refresh forced at the boundary
+    assert store.layout_generation == 1
+    man1 = read_sidecar_manifest(tr.checkpoint_path(1))
+    assert man1["stream_generation"] == 1
+    assert man1["bin_mapper_generation"] == 1
+
+
+def test_refresh_then_kill_resume_bit_identical(monkeypatch, tmp_path):
+    """Acceptance: a kill mid-refit AFTER a bin refresh resumes
+    bit-identically against the refreshed mapper (the sidecar carries the
+    mapper generation; refreshes are fenced to generation boundaries)."""
+    from lightgbm_tpu.checkpoint import read_sidecar_manifest
+
+    monkeypatch.setenv("LGBM_TPU_DRIFT", "1")
+    monkeypatch.setenv("LGBM_TPU_DRIFT_CHECK_ROWS", "256")
+    params = dict(BASE)
+
+    def run(kill):
+        X, y = _binary_chunks(n=2560, shift_from=1024)
+        store = RowBlockStore(params=params, bin_sample_rows=1024)
+        tr = ContinuousTrainer(
+            params, store, num_boost_round=6,
+            checkpoint_dir=str(tmp_path / ("crashy" if kill else "clean")))
+        store.push_rows(X[:1024], label=y[:1024])
+        assert tr.step() is not None
+        for lo in range(1024, 2560, 256):
+            store.push_rows(X[lo:lo + 256], label=y[lo:lo + 256])
+        assert store._drift.alarmed
+        if kill:
+            faults.install("kill@3")
+            with pytest.raises(InjectedFault):
+                tr.step()  # refresh + pin happened, then train died
+            faults.clear()
+            assert store.layout_generation == 1
+        booster = tr.step()
+        manifest = read_sidecar_manifest(tr.checkpoint_path(1))
+        return booster.model_to_string(), store.layout_generation, manifest
+
+    clean_text, clean_gen, _ = run(kill=False)
+    crash_text, crash_gen, manifest = run(kill=True)
+    assert clean_gen == crash_gen == 1
+    assert manifest["bin_mapper_generation"] == 1
+    assert crash_text == clean_text
+
+
+def test_bad_generation_rejected_and_never_serves(tmp_path):
+    """Chaos acceptance (gate): a poisoned generation is rejected with the
+    full rollback paper trail and never answers a single predict — every
+    response during the window is byte-identical to the prior model's."""
+    from lightgbm_tpu import telemetry
+    from lightgbm_tpu.serving import PredictionService
+    from lightgbm_tpu.utils.timer import global_timer
+
+    X, y = _binary_chunks(n=2048, f=6)
+    store = RowBlockStore(params=dict(BASE))
+    store.push_rows(X[:1024], label=y[:1024])
+    svc = PredictionService(max_batch_rows=512, batch_window_s=0.0005)
+    tr = ContinuousTrainer(dict(BASE), store, num_boost_round=3,
+                           service=svc, model_name="live",
+                           checkpoint_dir=str(tmp_path),
+                           holdout_rows=256, gate_tolerance=0.1)
+    try:
+        with telemetry.capture(None, label="gate-test",
+                               watch_compiles=False) as sess:
+            tr.step()
+            assert svc.registry.get("live").version == 1
+            expected = svc.predict("live", X[:16], raw_score=True)
+
+            faults.install("bad_generation@1")
+            store.push_rows(X[1024:2048], label=y[1024:2048])
+            failures, done = [], threading.Event()
+
+            def hammer():
+                while not done.is_set():
+                    try:
+                        out = svc.predict("live", X[:16], raw_score=True)
+                        if not np.array_equal(out, expected):
+                            failures.append("served non-prior-model bytes")
+                    except Exception as e:  # noqa: BLE001
+                        failures.append(repr(e))
+
+            threads = [threading.Thread(target=hammer) for _ in range(3)]
+            for t in threads:
+                t.start()
+            rejected = tr.step()
+            done.set()
+            for t in threads:
+                t.join()
+            assert rejected is None
+            assert failures == []
+            assert tr.generation == 1  # not advanced
+            assert svc.registry.get("live").version == 1  # serving untouched
+            assert global_timer.counters["stream_generation_rejected"] >= 1
+            events = [e for e in sess.events
+                      if e.get("ev") == "generation_rejected"]
+            assert events and events[-1]["generation"] == 1
+            assert events[-1]["candidate_loss"] > events[-1]["serving_loss"]
+
+            # the retry resumes the checkpointed (clean) model and passes
+            retried = tr.step()
+            assert retried is not None
+            assert tr.generation == 2
+            assert svc.registry.get("live").version == 2
+    finally:
+        svc.close()
+
+
+def test_drift_off_is_default_and_bit_identical(monkeypatch):
+    """LGBM_TPU_DRIFT unset => no monitor object exists at all (the push
+    path pays one is-None check) and models are bit-identical to a
+    drift-enabled run that never refreshes."""
+    X, y = _binary_chunks(n=1536, f=6)
+
+    def run():
+        store = RowBlockStore(params=dict(BASE), bin_sample_rows=512)
+        for lo in range(0, 1536, 256):
+            store.push_rows(X[lo:lo + 256], label=y[lo:lo + 256])
+        return store, train(dict(BASE), store.to_basic_dataset(
+            params=dict(BASE)), num_boost_round=3)
+
+    monkeypatch.delenv("LGBM_TPU_DRIFT", raising=False)
+    store_off, model_off = run()
+    assert store_off._drift is None
+    monkeypatch.setenv("LGBM_TPU_DRIFT", "1")
+    store_on, model_on = run()
+    assert store_on._drift is not None
+    assert model_off.model_to_string() == model_on.model_to_string()
+
+
+def test_sketch_corrupt_discards_sketch_keeps_cut_points(monkeypatch):
+    """Chaos: planted sketch corruption must be caught by the health check
+    at refresh time — the feature keeps its current cut points instead of
+    refitting them from garbage, and the discard is counted."""
+    from lightgbm_tpu.utils.timer import global_timer
+
+    monkeypatch.setenv("LGBM_TPU_DRIFT", "1")
+    monkeypatch.setenv("LGBM_TPU_DRIFT_CHECK_ROWS", "256")
+    faults.install("sketch_corrupt@2")
+    X, y = _binary_chunks(n=2048, f=6)
+    store = RowBlockStore(params=dict(BASE), bin_sample_rows=512)
+    for lo in range(0, 2048, 256):
+        store.push_rows(X[lo:lo + 256], label=y[lo:lo + 256])
+    assert not store._drift.sketches[2].healthy()
+    discarded_before = global_timer.counters.get("drift_sketch_discarded", 0)
+    old_mapper = store._layout.mappers[2]
+    assert store.maybe_refresh_bins(force=True) is True
+    assert store._layout.mappers[2] is old_mapper  # kept, not refitted
+    assert global_timer.counters["drift_sketch_discarded"] \
+        == discarded_before + 1
+    # the discarded sketch was replaced fresh and is healthy again
+    assert store._drift.sketches[2].healthy()
+
+
+def test_canary_promote_and_rollback():
+    """Canary lifecycle on the serving facade: a clean candidate promotes
+    after its window; a failing candidate rolls back mid-request with the
+    caller still answered from the primary."""
+    from lightgbm_tpu.serving import PredictionService
+
+    X, y = _data(n=512, f=6)
+    b1 = _model(BASE, X, y, rounds=2)
+    b2 = _model(BASE, X, y, rounds=5)
+
+    svc = PredictionService(max_batch_rows=256, batch_window_s=0.0005)
+    try:
+        svc.load_model("m", booster=b1)
+        svc.start_canary("m", booster=b2, fraction=0.5, promote_after=3)
+        assert svc.canary_info()["active"]
+        for _ in range(12):
+            out = svc.predict("m", X[:8], raw_score=True)
+            assert out.shape[0] == 8
+        info = svc.canary_info()
+        assert not info["active"] and info["promoted"] == 1
+        assert svc.registry.get("m").version == 2
+        np.testing.assert_allclose(
+            np.asarray(svc.predict("m", X[:8], raw_score=True)),
+            np.asarray(b2.predict(X[:8], raw_score=True)), rtol=1e-5)
+    finally:
+        svc.close()
+
+    svc = PredictionService(max_batch_rows=256, batch_window_s=0.0005)
+    try:
+        svc.load_model("m", booster=b1)
+        svc.start_canary("m", booster=b2, fraction=1.0, promote_after=50)
+        # 3 straight dispatch failures open the breaker (the batcher keeps
+        # answering via its host-path retry, so no caller ever fails);
+        # the next canary routing decision sees the pressure and rolls back
+        faults.install("predict_fail@1")
+        for _ in range(5):
+            out = svc.predict("m", X[:8], raw_score=True)
+            assert out.shape[0] == 8  # every request still answered
+        info = svc.canary_info()
+        assert not info["active"] and info["rolled_back"] == 1
+        assert svc.registry.get("m").version == 1  # primary untouched
+        from lightgbm_tpu.serving.errors import ModelNotFound
+        with pytest.raises(ModelNotFound):
+            svc.registry.get("m!canary")
+    finally:
+        svc.close()
+
+
+@pytest.mark.slow
+def test_drift_overhead_under_two_percent(monkeypatch):
+    """Acceptance bound: sketches + occupancy + gate cost < 2% of the
+    ingest+refit wall (median of repeated runs to beat host noise)."""
+    import time
+
+    X, y = _binary_chunks(n=40000, f=12)
+
+    def wall(drift_on):
+        if drift_on:
+            monkeypatch.setenv("LGBM_TPU_DRIFT", "1")
+        else:
+            monkeypatch.delenv("LGBM_TPU_DRIFT", raising=False)
+        t0 = time.perf_counter()
+        store = RowBlockStore(params=dict(BASE), bin_sample_rows=8192)
+        for lo in range(0, 40000, 2048):
+            store.push_rows(X[lo:lo + 2048], label=y[lo:lo + 2048])
+        tr = ContinuousTrainer(dict(BASE), store, num_boost_round=5,
+                               holdout_rows=2048 if drift_on else 0)
+        assert tr.step() is not None
+        return time.perf_counter() - t0
+
+    wall(False)  # warm jit caches out of the measurement
+    base = min(wall(False) for _ in range(3))
+    on = min(wall(True) for _ in range(3))
+    assert on <= base * 1.02 + 0.25, (on, base)
+
+
 # ------------------------------------------------------- C-API shims
 
 class _FakeFfi:

@@ -12,7 +12,12 @@ any violated invariant:
   3. a mid-refit injected kill, resumed bit-identically from the
      generation checkpoint while fresh pushes keep landing;
   4. a refit -> hot-swap loop against a live PredictionService under
-     concurrent predict load, with zero failed predicts.
+     concurrent predict load, with zero failed predicts;
+  5. a planted drift_shift fault tripping the PSI alarm (flight dump on
+     disk), a sketch-driven bin-mapper refresh that measurably restores
+     bin resolution while the published model stays byte-identical, and
+     a poisoned generation rejected by the holdout quality gate before
+     a clean retry publishes.
 
 When a telemetry dir is given the run records a full event stream there
 (validate with `python tools/teldiff.py --self-check <dir>`).
@@ -154,6 +159,61 @@ def main() -> int:
         assert flywheel.generation == 3, flywheel.generation
         assert svc.registry.get("live").version == 3
         print("# flywheel: 3 generations hot-swapped, 0 failed predicts")
+
+        # -- 5. drift alarm -> bin refresh -> quality-gated publish ------
+        d_saved = {k: os.environ.get(k) for k in
+                   ("LGBM_TPU_DRIFT", "LGBM_TPU_DRIFT_CHECK_ROWS",
+                    "LGBM_TPU_FLIGHT_DIR")}
+        # flight dumps land next to the event stream when a telemetry dir
+        # is given, so the CI artifact ships the drift postmortems too
+        flight_dir = tel_dir or tempfile.mkdtemp(prefix="stream-smoke-flight-")
+        os.environ["LGBM_TPU_DRIFT"] = "1"
+        os.environ["LGBM_TPU_DRIFT_CHECK_ROWS"] = "512"
+        os.environ["LGBM_TPU_FLIGHT_DIR"] = flight_dir
+        faults.install("drift_shift@1024:0")
+        try:
+            dstore = RowBlockStore(params=params, bin_sample_rows=1024)
+            dtr = ContinuousTrainer(params, dstore, num_boost_round=3,
+                                    holdout_rows=512)
+            dstore.push_rows(X[:1024], label=y[:1024])
+            old_text = dtr.step().model_to_string()
+            for lo in range(1024, 3072, 512):
+                dstore.push_rows(X[lo:lo + 512], label=y[lo:lo + 512])
+            mon = dstore._drift
+            assert mon is not None and mon.alarmed, "drift alarm missing"
+            assert mon.alarm_feature == 0, mon.alarm_feature
+            assert os.path.exists(
+                os.path.join(flight_dir, "flight-drift_alarm.json")), \
+                "drift alarm fired without a flight dump"
+            shifted = X[1024:2048, 0] * 3.0 + 10.0  # the fault's transform
+            mapper0 = dstore._layout.mappers[0]
+            bins_before = len(np.unique(mapper0.values_to_bins(shifted)))
+            assert dstore.maybe_refresh_bins() is True, "refresh was a no-op"
+            assert dstore.layout_generation == 1
+            mapper0 = dstore._layout.mappers[0]
+            bins_after = len(np.unique(mapper0.values_to_bins(shifted)))
+            assert bins_after > bins_before, (bins_before, bins_after)
+            assert dtr.booster.model_to_string() == old_text, \
+                "bin refresh mutated the published model"
+            faults.clear()
+            # gate: a poisoned candidate never publishes, serving untouched
+            faults.install("bad_generation@1")
+            assert dtr.step() is None, "poisoned generation passed the gate"
+            assert dtr.generation == 1, dtr.generation
+            assert dtr.booster.model_to_string() == old_text
+            faults.clear()
+            assert dtr.step() is not None, "clean retry failed to publish"
+            assert dtr.generation == 2, dtr.generation
+            print(f"# drift: alarm on feature 0, refresh restored "
+                  f"{bins_before}->{bins_after} distinct bins, 1 poisoned "
+                  "generation rejected, published model byte-identical")
+        finally:
+            faults.clear()
+            for k, v in d_saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
     finally:
         if tel_dir:
             telemetry.stop()
