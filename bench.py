@@ -143,6 +143,79 @@ def _wave_traffic_fields(ds) -> dict:
     return fields
 
 
+def _kernel_micro_fields(ds, n_rows: int) -> dict:
+    """Per-dispatch microlatency of the round-8 kernels, measured with the
+    session's real dataset shapes on this backend so kernel-on/off ledger
+    rows attribute the fused-scan and device-GOSS wins directly:
+
+    * scan_kernel_ms: one `find_best_split` dispatch (the same call the
+      serial learner's per-leaf scan makes; routed through the fused
+      Pallas kernel or the XLA path by LGBM_TPU_SCAN_PALLAS);
+    * goss_device_gather_ms: one jitted GOSS select (score + stable
+      argsort + top-rate mask + small-gradient rescale) at the training
+      row count — the work the device bag keeps off the host.
+    """
+    import numpy as np
+
+    out = {}
+    rng = np.random.default_rng(7)
+    try:
+        import jax.numpy as jnp
+
+        from lightgbm_tpu.ops.histogram import build_histogram
+        from lightgbm_tpu.ops.split import find_best_split, make_feature_meta
+
+        core = ds._handle
+        s = min(core.num_data, 100_000)
+        g = rng.standard_normal(s, dtype=np.float32)
+        h = np.abs(rng.standard_normal(s, dtype=np.float32)) + 0.1
+        gh = jnp.asarray(np.stack([g, h, np.ones(s, np.float32)], axis=1))
+        B = int(core.group_bin_counts().max())
+        hist = build_histogram(jnp.asarray(core.bins[:, :s]), gh, B)
+        meta = make_feature_meta(core, B)
+        pvec = jnp.asarray([0, 0, 20, 1e-3, 0, 0], dtype=jnp.float32)
+        totals = hist[0].sum(axis=0).astype(jnp.float32)
+        find_best_split(hist, totals, meta, pvec).block_until_ready()
+        reps = 20
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            rec = find_best_split(hist, totals, meta, pvec)
+        rec.block_until_ready()
+        out["scan_kernel_ms"] = round(
+            (time.perf_counter() - t0) / reps * 1e3, 3)
+    except Exception as e:  # noqa: BLE001 - secondary must not kill primary
+        out["scan_kernel_error"] = repr(e)[:200]
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from lightgbm_tpu.models import sample_strategy as ss
+
+        n = min(n_rows, 1_000_000)
+        gd = jnp.asarray(rng.standard_normal(n, dtype=np.float32))
+        hd = jnp.asarray(
+            np.abs(rng.standard_normal(n, dtype=np.float32)) + 0.1)
+        top_k = max(int(np.ceil(n * 0.2)), 1)
+        n_sampled = min(int(np.ceil(n * 0.1)), n - top_k)
+        pos = jnp.asarray(rng.choice(
+            n - top_k, n_sampled, replace=False).astype(np.int32))
+        from functools import partial
+
+        select = jax.jit(partial(ss._goss_select, top_k=top_k))
+        mult = jnp.float32(8.0)
+        select(gd, hd, pos, mult)[1].block_until_ready()
+        reps = 20
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            _, gr, _ = select(gd, hd, pos, mult)
+        gr.block_until_ready()
+        out["goss_device_gather_ms"] = round(
+            (time.perf_counter() - t0) / reps * 1e3, 3)
+    except Exception as e:  # noqa: BLE001 - secondary must not kill primary
+        out["goss_kernel_error"] = repr(e)[:200]
+    return out
+
+
 def _bench_gang_recovery() -> dict:
     """Measure one detect -> reap -> respawn cycle of the elastic gang
     supervisor on stub workers (rank 1 exits nonzero on attempt 0; the
@@ -217,6 +290,19 @@ def run_bench(n_rows: int) -> dict:
         # pollute the timer totals with their own boosting scopes
         from lightgbm_tpu import perfmodel
         from lightgbm_tpu.utils.timer import global_timer
+
+        # round-8 wave controller + kernel instrumentation: the observed
+        # commit rate and the K the adaptive controller settled on (both 0
+        # when the run never dispatched the device learner), plus the
+        # per-dispatch microlatency of the fused scan and the device GOSS
+        # select at this session's shapes
+        spec = int(global_timer.counters.get("wave_splits_speculated", 0))
+        out["wave_commit_rate"] = round(
+            int(global_timer.counters.get("wave_splits_committed", 0))
+            / spec, 4) if spec else 0.0
+        out["adaptive_k_final"] = int(
+            global_timer.counters.get("wave_k", 0))
+        out.update(_kernel_micro_fields(ds, n_rows))
 
         try:
             import jax
@@ -567,6 +653,9 @@ def main() -> None:
                       "stream_train_rows_per_sec", "hbm_resident_fraction",
                       "stream_h2d_overlap_pct", "drift_check_overhead_pct",
                       "bin_refresh_ms", "gate_eval_ms", "stream_error",
+                      "wave_commit_rate", "adaptive_k_final",
+                      "scan_kernel_ms", "goss_device_gather_ms",
+                      "scan_kernel_error", "goss_kernel_error",
                       "attribution"):
                 if k in res:
                     record[k] = res[k]

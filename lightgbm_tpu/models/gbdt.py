@@ -23,7 +23,7 @@ from ..health import create_monitor
 from ..io.dataset import Dataset
 from ..metrics import create_metric
 from ..objectives import ObjectiveFunction
-from ..ops.partition import pad_indices
+from ..ops.partition import bucket_size, pad_indices
 from ..ops.predict import (PredictorCache, pack_ensemble, predict_dtype,
                            predict_raw, predict_raw_streamed,
                            stream_chunk_rows)
@@ -33,7 +33,7 @@ from ..treelearner import create_tree_learner
 from ..utils import faults, sanitize
 from ..utils.log import Log
 from ..utils.timer import global_timer
-from .sample_strategy import create_sample_strategy
+from .sample_strategy import DeviceBag, create_sample_strategy
 from .serialize import GBDTModel
 from .tree import Tree
 
@@ -454,11 +454,23 @@ class GBDT:
             return
         self._cur_bag = bag
         self._oob_padded_ready = True
-        if bag is not None and len(bag) < self.num_data:
+        if bag is None or len(bag) >= self.num_data:
+            self._oob_padded = None
+        elif isinstance(bag, DeviceBag):
+            # device bag: build the padded OOB index set from the mask
+            # without pulling it to host — sentinel rows (id == num_data,
+            # same as pad_indices) sort past every real index
+            n = self.num_data
+            p = bucket_size(n - bag.n_bag)
+            base = jnp.where(bag.mask, n,
+                             jnp.arange(n, dtype=jnp.int32))
+            if p > n:
+                base = jnp.concatenate(
+                    [base, jnp.full(p - n, n, dtype=jnp.int32)])
+            self._oob_padded = jnp.sort(base)[:p]
+        else:
             oob = np.setdiff1d(np.arange(self.num_data, dtype=np.int32), bag)
             self._oob_padded = jnp.asarray(pad_indices(oob, self.num_data))
-        else:
-            self._oob_padded = None
 
     @property
     def _depth_bound(self) -> int:

@@ -1,20 +1,35 @@
 """Row sampling strategies: bagging and GOSS.
 
 Counterpart of src/boosting/sample_strategy.{h,cpp} (factory), bagging.hpp
-(BaggingSampleStrategy) and goss.hpp (GOSSStrategy). The strategy runs on
-host once per iteration over the gradient arrays (GOSS needs |g·h| scores)
-and hands the tree learner a bag index set; gradient rescaling for GOSS's
-small-gradient sample happens on device.
+(BaggingSampleStrategy) and goss.hpp (GOSSStrategy). Bagging runs on host
+once per iteration; GOSS has two equivalent homes for its |g·h| top-rate
+selection:
+
+* host (the original path, and the default off-accelerator): pull the
+  gradients, argsort on host, hand the learner a host index bag;
+* device (LGBM_TPU_GOSS_DEVICE, default auto = on for tpu/axon backends):
+  a jitted score + stable-argsort + scatter keeps the gradients and the
+  bag membership mask on device — the only host work per iteration is the
+  MT19937 position draw, which consumes the generator exactly like the
+  host path's `choice(rest, ...)` (both reduce to `permutation(n)[:k]`),
+  so the two paths pick bit-identical bags.
+
+Both paths score in f32 with the multiclass per-class terms added in class
+order (a fixed association), so the sort keys — and therefore the stable
+argsort permutation — match bit for bit.
 """
 from __future__ import annotations
 
 import math
+import os
+from functools import partial
 from typing import Optional, Tuple
 
 import numpy as np
 
 from ..config import Config
 from ..utils.log import Log
+from ..utils.timer import global_timer
 
 
 class SampleStrategy:
@@ -75,6 +90,83 @@ class BaggingSampleStrategy(SampleStrategy):
         return self._bag, grad, hess
 
 
+class DeviceBag:
+    """A bag that lives on device: membership as a bool mask, the count
+    known host-side from shapes alone. Consumers that genuinely need host
+    indices (the serial learner's RowPartition, the distributed learners)
+    materialize them lazily through `.indices` — one pull per bag, outside
+    the per-iteration sampling path."""
+
+    def __init__(self, mask, n_bag: int, num_data: int) -> None:
+        self.mask = mask  # device bool [num_data]
+        self.n_bag = int(n_bag)
+        self.num_data = int(num_data)
+        self._host: Optional[np.ndarray] = None
+
+    def __len__(self) -> int:
+        return self.n_bag
+
+    @property
+    def indices(self) -> np.ndarray:
+        if self._host is None:
+            self._host = np.nonzero(np.asarray(self.mask))[0].astype(np.int32)
+        return self._host
+
+
+def host_bag_indices(bag):
+    """Normalize a bag to host int32 indices (identity for host bags)."""
+    if isinstance(bag, DeviceBag):
+        return bag.indices
+    return bag
+
+
+def use_device_goss() -> bool:
+    """LGBM_TPU_GOSS_DEVICE: 1/on forces the device selection, 0/off the
+    host path; auto (default) enables it on accelerator backends where the
+    per-iteration gradient pull is the cost being removed."""
+    mode = os.environ.get("LGBM_TPU_GOSS_DEVICE", "auto").lower()
+    if mode in ("0", "false", "off", "host"):
+        return False
+    if mode in ("1", "true", "on", "device"):
+        return True
+    import jax
+
+    backend = jax.default_backend()
+    return "tpu" in backend or backend == "axon"
+
+
+def _goss_select(grad, hess, sampled_pos, multiplier, top_k: int):
+    """Device half of GOSS: f32 |g·h| score, stable argsort (identical
+    permutation to the host np stable sort — stability uniquely determines
+    the output for equal keys), top-`top_k` kept, `sampled_pos` indexes the
+    REST segment of the order (the host RNG drew positions, not rows), and
+    the sampled small-gradient rows are rescaled in place. Returns the
+    in-bag mask and the rescaled gradients; nothing touches the host."""
+    import jax.numpy as jnp
+
+    if grad.ndim == 1:
+        score = jnp.abs(grad * hess)
+    else:
+        # fixed class-order association — mirrors the host loop bit for bit
+        score = jnp.abs(grad[0] * hess[0])
+        for c in range(1, grad.shape[0]):
+            score = score + jnp.abs(grad[c] * hess[c])
+    order = jnp.argsort(-score, stable=True)
+    mask = jnp.zeros(score.shape[0], dtype=jnp.bool_)
+    mask = mask.at[order[:top_k]].set(True)
+    if sampled_pos.shape[0] > 0:
+        sampled = order[top_k:][sampled_pos]
+        mask = mask.at[sampled].set(True)
+        mult = jnp.asarray(multiplier, dtype=jnp.float32)
+        if grad.ndim == 1:
+            grad = grad.at[sampled].mul(mult)
+            hess = hess.at[sampled].mul(mult)
+        else:
+            grad = grad.at[:, sampled].mul(mult)
+            hess = hess.at[:, sampled].mul(mult)
+    return mask, grad, hess
+
+
 class GOSSStrategy(SampleStrategy):
     """Gradient-based One-Side Sampling — goss.hpp:30-172.
 
@@ -94,28 +186,66 @@ class GOSSStrategy(SampleStrategy):
             Log.fatal("top_rate and other_rate must be positive in GOSS")
         if config.bagging_freq > 0 and config.bagging_fraction < 1.0:
             Log.warning("Cannot use bagging in GOSS")
+        self._select_jit = None
+
+    def _sizes(self) -> Tuple[int, int, int]:
+        n = self.num_data
+        top_k = max(int(math.ceil(n * self.config.top_rate)), 1)
+        other_k = int(math.ceil(n * self.config.other_rate))
+        n_rest = n - top_k
+        n_sampled = min(other_k, n_rest) if (other_k > 0 and n_rest > 0) else 0
+        return top_k, n_rest, n_sampled
+
+    def _bagging_device(self, iteration: int, grad, hess):
+        """Device-resident selection: the host draws sample POSITIONS from
+        the same MT19937 stream (`choice(n_rest, k)` and the host path's
+        `choice(rest, k)` both reduce to `permutation(n_rest)[:k]`), the
+        jitted kernel turns them into rows of the device-side order."""
+        import jax
+        import jax.numpy as jnp
+
+        top_k, n_rest, n_sampled = self._sizes()
+        rng = np.random.RandomState(self.config.bagging_seed + iteration)
+        if n_sampled > 0:
+            pos = rng.choice(n_rest, n_sampled, replace=False)
+        else:
+            pos = np.empty(0, dtype=np.int64)
+        multiplier = (1.0 - self.config.top_rate) / max(
+            self.config.other_rate, 1e-12)
+        if self._select_jit is None:
+            self._select_jit = jax.jit(
+                partial(_goss_select, top_k=top_k))
+        with global_timer.scope("goss_device_select"):
+            mask, grad, hess = self._select_jit(
+                grad, hess, jnp.asarray(pos.astype(np.int32)),
+                jnp.float32(multiplier))
+        return DeviceBag(mask, top_k + n_sampled, self.num_data), grad, hess
 
     def bagging(self, iteration: int, grad, hess):
         lr = max(self.config.learning_rate, 1e-12)
         if iteration < int(1.0 / lr):
             return None, grad, hess
+        if use_device_goss():
+            return self._bagging_device(iteration, grad, hess)
         import jax.numpy as jnp
 
-        g = np.asarray(grad, dtype=np.float64)
-        h = np.asarray(hess, dtype=np.float64)
+        g = np.asarray(grad, dtype=np.float32)
+        h = np.asarray(hess, dtype=np.float32)
         if g.ndim == 1:
             score = np.abs(g * h)
         else:
-            score = np.abs(g * h).sum(axis=0)
-        n = self.num_data
-        top_k = max(int(math.ceil(n * self.config.top_rate)), 1)
-        other_k = int(math.ceil(n * self.config.other_rate))
+            # per-class terms added in class order: the same f32 value
+            # chain as the device kernel, so the sort keys match bitwise
+            score = np.abs(g[0] * h[0])
+            for c in range(1, g.shape[0]):
+                score = score + np.abs(g[c] * h[c])
+        top_k, n_rest, n_sampled = self._sizes()
         order = np.argsort(-score, kind="stable")
         top = order[:top_k]
         rest = order[top_k:]
         rng = np.random.RandomState(self.config.bagging_seed + iteration)
-        if other_k > 0 and len(rest) > 0:
-            sampled = rng.choice(rest, min(other_k, len(rest)), replace=False)
+        if n_sampled > 0:
+            sampled = rng.choice(rest, n_sampled, replace=False)
         else:
             sampled = np.empty(0, dtype=np.int64)
         multiplier = (1.0 - self.config.top_rate) / max(

@@ -349,7 +349,18 @@ def per_feature_best(fh: jax.Array, totals: jax.Array, meta: FeatureMeta,
     core scan shared by the serial learner and the data/feature/voting
     parallel learners (the reference runs FindBestThresholdSequentially per
     rank feature block, data_parallel_tree_learner.cpp:305+).
+
+    On TPU backends the numeric lanes route to the fused Pallas kernel
+    (ops/scan_pallas.py, bit-identical; LGBM_TPU_SCAN_PALLAS=0 restores this
+    XLA body byte-for-byte). Monotone-constrained scans — the clamped-output
+    gain variant below — always take the XLA body.
     """
+    from . import scan_pallas  # local import: scan_pallas has no split dep
+    if (constraint is None and fh.dtype == jnp.float32
+            and scan_pallas.use_scan_pallas()):
+        return scan_pallas.per_feature_best_fused(
+            fh, totals, meta, params, feature_mask, penalty,
+            interpret=scan_pallas.interpret_mode())
     l1, l2, min_data, min_hess, min_gain, max_delta = (
         params[0], params[1], params[2], params[3], params[4], params[5])
     F, Bmax, _ = fh.shape

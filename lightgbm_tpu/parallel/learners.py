@@ -36,6 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import Config
 from ..io.dataset import Dataset
+from ..models.sample_strategy import host_bag_indices
 from ..models.tree import Tree
 from ..ops.histogram import build_histogram
 from ..ops.partition import split_decision_bins, split_decision_bins_cat
@@ -225,6 +226,9 @@ class DataParallelTreeLearner(SerialTreeLearner):
     def _begin_tree(self, gh_ext: jax.Array,
                     bag_indices: Optional[np.ndarray]) -> None:
         n, npad = self.num_data, self.n_pad
+        # sharded learners address rows host-side; a DeviceBag (device
+        # GOSS) materializes its indices once here
+        bag_indices = host_bag_indices(bag_indices)
         gh_ext = self._prepare_gh(gh_ext)
         gh = jnp.concatenate(
             [gh_ext[:n], jnp.zeros((npad - n, gh_ext.shape[1]), gh_ext.dtype)])
@@ -536,6 +540,7 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
                     bag_indices: Optional[np.ndarray] = None) -> _PendingTree:
         cfg = self.config
         n, npad = self.num_data, self.n_pad
+        bag_indices = host_bag_indices(bag_indices)
         if self.quantized:
             gh_ext = self._prepare_gh(gh_ext)  # int8 rows + scales
         gh = gh_ext[:-1]

@@ -86,13 +86,20 @@ def stream_block_bytes(block_rows: int, n_groups: int, plane_bytes: int) -> int:
 
 
 def scan_bytes_per_wave(wave_width: int, f_pad: int, max_bins: int,
-                        ch: int = 3, pool_bytes: int = 4) -> int:
-    """Gain-scan read volume per wave: the cumsum+argmax sweep reads the
-    [K, F_pad, Bmax, CH] histogram pool block and writes the [2K, F_pad,
-    REC] best-record store (PERF_NOTES round-4 step 5)."""
+                        ch: int = 3, pool_bytes: int = 4,
+                        fused: bool = False) -> int:
+    """Gain-scan traffic per wave (PERF_NOTES round-4 step 5, round-8):
+    both regimes read the [K, F_pad, Bmax, CH] histogram pool block and
+    write the [2K, F_pad, REC] best-record store; the unfused XLA path
+    additionally materializes the two per-lane gain tensors ([K, F_pad,
+    2*Bmax] f32, written then re-read by the argmax) through HBM, which
+    the fused Pallas kernel (ops/scan_pallas.py) keeps in VMEM."""
     k = int(wave_width)
-    return (k * int(f_pad) * int(max_bins) * int(ch) * int(pool_bytes)
+    base = (k * int(f_pad) * int(max_bins) * int(ch) * int(pool_bytes)
             + 2 * k * int(f_pad) * REC_FIELDS * 4)
+    if not fused:
+        base += 2 * k * int(f_pad) * 2 * int(max_bins) * 4
+    return base
 
 
 def ici_bytes_per_wave(wave_width: int, f_pad: int, max_bins: int,

@@ -53,8 +53,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..models.sample_strategy import DeviceBag
 from ..models.tree import Tree
 from ..ops.histogram import build_histogram
+from ..ops.partition import bucket_size
 from ..ops.split import (SPLIT_FIELDS, ScanMeta, SplitInfo, find_best_split,
                          fix_feature_hist, gather_feature_hist_raw,
                          per_feature_best, reduce_best_record)
@@ -68,6 +70,12 @@ from .serial import SerialTreeLearner, _leaf_output_host
 REC = len(SPLIT_FIELDS)
 # rec_store row: [leaf, parent_output, depth, valid] + SPLIT_FIELDS
 STORE = REC + 4
+
+# gain-adaptive wave-width thresholds: commit rate (committed splits /
+# speculated splits) below which K steps one rung down, above which it
+# steps back up toward the LGBM_TPU_WAVE ceiling
+_WAVE_SHRINK_RATE = 0.5
+_WAVE_GROW_RATE = 0.9
 
 
 class FeatureTables(NamedTuple):
@@ -780,6 +788,7 @@ class _PendingTree(NamedTuple):
     hist_rows: jax.Array
     n_waves: jax.Array
     n_bag: int
+    wave_k: int = 0  # wave width this tree was dispatched with
 
 
 class DeviceTreeLearner(SerialTreeLearner):
@@ -800,6 +809,18 @@ class DeviceTreeLearner(SerialTreeLearner):
         # 21 -> 126 channels (one 128-lane M-tile on the MXU); raise for
         # deeper amortization, lower if speculation hit-rate drops.
         self.wave = int(os.environ.get("LGBM_TPU_WAVE", "21"))
+        # gain-adaptive wave width: `wave` is the ceiling, `wave_k` the
+        # width actually dispatched; _record_wave_efficiency moves it one
+        # power-of-two rung per tree from the observed commit rate
+        # (LGBM_TPU_ADAPTIVE_WAVE=0 pins K to the ceiling). Rungs reuse
+        # ops.partition.bucket_size so `batch` — a static jit arg of
+        # grow_tree_on_device — takes at most ~log2(wave) distinct values
+        # per run instead of recompiling on every width change.
+        self._wave_cap = max(1, min(self.wave, int(config.num_leaves)))
+        self._adaptive_wave = os.environ.get(
+            "LGBM_TPU_ADAPTIVE_WAVE", "1").lower() not in (
+                "0", "false", "off")
+        self.wave_k = self._wave_cap
         self._gh_bf16 = (not self.quantized) and os.environ.get(
             "LGBM_TPU_GH_BF16", "").lower() in ("1", "true", "on")
         if os.environ.get("LGBM_TPU_GH_BF16", "").lower() in (
@@ -858,10 +879,12 @@ class DeviceTreeLearner(SerialTreeLearner):
         # the replay scan sweeps the [K, G, Bpad, CH] pool block and writes
         # the [2K, G, REC] best-record store; the pool is 4-byte in both the
         # float and quantized (int32) regimes
+        from ..ops import scan_pallas
         global_timer.set_count(
             "device_scan_bytes_per_wave",
-            perfmodel.scan_bytes_per_wave(self.wave, G,
-                                          self.group_bin_padded))
+            perfmodel.scan_bytes_per_wave(self.wave_k, G,
+                                          self.group_bin_padded,
+                                          fused=scan_pallas.use_scan_pallas()))
 
     def train(self, gh_ext: jax.Array,
               bag_indices: Optional[np.ndarray] = None) -> Tree:
@@ -874,7 +897,15 @@ class DeviceTreeLearner(SerialTreeLearner):
         if self.quantized:
             gh_ext = self._prepare_gh(gh_ext)  # int8 rows + scales
         gh = gh_ext[:-1]
-        if bag_indices is not None:
+        if isinstance(bag_indices, DeviceBag):
+            # device-resident bag (GOSS): the mask never touches the host —
+            # same where() ops as the host-index branch below, so the masked
+            # gh and leaf seeds are bit-identical for an identical bag
+            mask = bag_indices.mask
+            leaf_id0 = jnp.where(mask, 0, -1).astype(jnp.int32)
+            gh = jnp.where(mask[:, None], gh, jnp.zeros((), gh.dtype))
+            n_bag = bag_indices.n_bag
+        elif bag_indices is not None:
             in_bag = np.zeros(self.num_data, dtype=bool)
             in_bag[np.asarray(bag_indices, dtype=np.int64)] = True
             leaf_id0 = jnp.asarray(np.where(in_bag, 0, -1), dtype=jnp.int32)
@@ -902,7 +933,7 @@ class DeviceTreeLearner(SerialTreeLearner):
                 self.bins_dev, gh, leaf_id0, self.meta, self.tables,
                 self.params_dev, fmask, num_leaves, self.group_bin_padded,
                 cfg.max_depth, quantized=self.quantized,
-                scale_vec=self._scale_vec, batch=self.wave,
+                scale_vec=self._scale_vec, batch=self.wave_k,
                 bagged=bag_indices is not None)
         with global_timer.scope("tree_device"):
             # bins_dev is COPIED per tree: grow_tree_on_device donates its
@@ -912,7 +943,7 @@ class DeviceTreeLearner(SerialTreeLearner):
                 self.tables, self.params_dev, fmask, num_leaves,
                 self.group_bin_padded,
                 cfg.max_depth, quantized=self.quantized,
-                scale_vec=self._scale_vec, batch=self.wave,
+                scale_vec=self._scale_vec, batch=self.wave_k,
                 bagged=bag_indices is not None)
         # start the device->host copies without blocking; finalize() (maybe
         # a full iteration later, under the async pipeline) pays no wait if
@@ -922,7 +953,7 @@ class DeviceTreeLearner(SerialTreeLearner):
             if start is not None:
                 start()
         return _PendingTree(Tree(num_leaves), rec_store, leaf_id, hist_rows,
-                            n_waves, n_bag)
+                            n_waves, n_bag, wave_k=self.wave_k)
 
     def finalize(self, pending: _PendingTree) -> Tree:
         cfg = self.config
@@ -966,14 +997,18 @@ class DeviceTreeLearner(SerialTreeLearner):
 
     def _record_wave_efficiency(self, pending: _PendingTree,
                                 tree: Tree) -> None:
-        """Committed-vs-speculated wave accounting: each wave partitions +
-        histograms K candidate splits but the replay commits only as many
-        as stay globally best-first — the measured ratio is the input the
-        gain-adaptive wave-width work needs (ROADMAP item 1)."""
+        """Committed-vs-speculated wave accounting + the gain-adaptive
+        wave-width controller: each wave partitions + histograms K candidate
+        splits but the replay commits only as many as stay globally
+        best-first — the measured ratio drives the next tree's K
+        (ROADMAP item 1; split decisions are K-invariant, so only the
+        amount of speculative work changes, never the model)."""
         from .. import telemetry, tracing
         n_waves = int(pending.n_waves)
+        wave_k = pending.wave_k or self.wave_k
         committed = tree.num_leaves - 1
-        speculated = n_waves * self.wave
+        speculated = n_waves * wave_k
+        commit_rate = committed / speculated if speculated else 1.0
         global_timer.add_count("device_waves", n_waves)
         global_timer.add_count("wave_splits_committed", committed)
         global_timer.add_count("wave_splits_speculated", speculated)
@@ -984,15 +1019,37 @@ class DeviceTreeLearner(SerialTreeLearner):
                      speculated=speculated)
         if telemetry.enabled():
             telemetry.emit(
-                "tree_wave", waves=n_waves, wave_width=self.wave,
+                "tree_wave", waves=n_waves, wave_width=wave_k,
                 committed=committed, speculated=speculated,
-                efficiency=round(committed / speculated, 4) if speculated
-                else 1.0,
+                efficiency=round(commit_rate, 4) if speculated else 1.0,
                 hist_rows=self.last_hist_rows,
                 ici_bytes_per_wave=int(global_timer.counters.get(
                     "device_ici_bytes_per_wave", 0)),
                 carry_bytes_per_wave=int(global_timer.counters.get(
                     "device_carry_bytes_per_wave", 0)))
+        new_k = self._next_wave_k(commit_rate)
+        if telemetry.enabled() and new_k != self.wave_k:
+            telemetry.emit("wave_ctl", wave_k=new_k, prev_k=self.wave_k,
+                           wave_commit_rate=round(commit_rate, 4))
+        self.wave_k = new_k
+        global_timer.set_count("wave_k", self.wave_k)
+
+    def _next_wave_k(self, commit_rate: float) -> int:
+        """One power-of-two rung per tree: commit rate under 50% means the
+        replay declined half the partition+histogram work a wave paid for —
+        halve K; above 90% speculation is nearly free — grow back toward the
+        ceiling. Rungs come from ops.partition.bucket_size, so the static
+        `batch` jit arg takes at most ~log2(wave) distinct values per run
+        (pinned by the recompile-watcher test in test_device_learner.py)."""
+        if not self._adaptive_wave:
+            return self.wave_k
+        k = self.wave_k
+        if commit_rate < _WAVE_SHRINK_RATE and k > 1:
+            return min(bucket_size(max(1, k // 2), minimum=1),
+                       self._wave_cap)
+        if commit_rate > _WAVE_GROW_RATE and k < self._wave_cap:
+            return min(bucket_size(k + 1, minimum=1), self._wave_cap)
+        return k
 
     def _renew_quantized_leaves_device(self, tree: Tree,
                                        leaf_id: jax.Array) -> None:

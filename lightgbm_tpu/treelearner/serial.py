@@ -29,6 +29,7 @@ import numpy as np
 
 from ..config import Config
 from ..io.dataset import Dataset
+from ..models.sample_strategy import host_bag_indices
 from ..models.tree import Tree
 from ..ops.histogram import build_histogram_rows, subtract_histogram
 from ..ops.partition import RowPartition
@@ -272,7 +273,9 @@ class SerialTreeLearner:
         self._hist_lru.clear()
         partition = RowPartition(self.num_data)
         if bag_indices is not None:
-            partition.set_used_indices(bag_indices)
+            # a DeviceBag (device GOSS) materializes host indices here —
+            # the host-driven learner's RowPartition is index-based anyway
+            partition.set_used_indices(host_bag_indices(bag_indices))
         self.partition = partition
         if self.col_sampler.active:
             self._tree_feature_mask = jnp.asarray(

@@ -48,7 +48,7 @@ class SyncInScopeError(RuntimeError):
 
 
 # scopes asserted to perform ZERO countable device syncs while open
-SYNC_FREE = {"tree_device"}
+SYNC_FREE = {"tree_device", "goss_device_select"}
 
 _forced: Optional[bool] = None
 _installed = False

@@ -46,6 +46,16 @@ def test_bench_smoke_cpu(tmp_path):
     # np_rows * (32*4 + 20) — assert we sit in the narrow-plane regime.
     n_pad = -(-20000 // 1024) * 1024
     assert record["est_carried_bytes_per_wave"] == n_pad * (32 + 20)
+    # round-8 kernel instrumentation: both microlatency fields are real
+    # timed dispatches (the fused-scan/XLA routing and the device GOSS
+    # select both run on any backend); the wave-controller fields are 0 on
+    # CPU benches (serial learner — no waves dispatched) but must exist
+    assert "scan_kernel_error" not in record, record
+    assert "goss_kernel_error" not in record, record
+    assert record["scan_kernel_ms"] > 0
+    assert record["goss_device_gather_ms"] > 0
+    assert 0.0 <= record["wave_commit_rate"] <= 1.0
+    assert record["adaptive_k_final"] >= 0
     # inference metric: chunked streaming predict must have run and timed.
     # 20000 rows -> chunk = bucket_size(5000, 1024) = 8192 (3 chunks).
     assert record["predict_rows_per_sec"] > 0

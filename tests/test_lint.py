@@ -152,6 +152,19 @@ def test_r5_scope_covers_serving_hot_path(fixture_result):
     assert len(bad) == 1 and "'big_untimed_pack'" in bad[0].message
 
 
+def test_r5_scope_covers_fused_scan(fixture_result):
+    # ops/scan_pallas.py joined the R5 scope (scope_exact, round 8): the
+    # untimed staging helper fires at its def line; the jitted dispatch
+    # stays exempt (the call site owns the scope, device.py's
+    # "tree_device")
+    bad = _hits(fixture_result, "untimed-hot-func", "ops/scan_pallas.py")
+    assert len(bad) == 1 and "'big_untimed_stage'" in bad[0].message
+    assert bad[0].line == 7
+    msgs = [v.message for v in
+            fixture_result.violations + fixture_result.suppressed]
+    assert not any("'big_jitted_scan'" in m for m in msgs)
+
+
 def test_r5_suppression_honored(fixture_result):
     sup = _hits(fixture_result, "untimed-hot-func", suppressed=True)
     assert len(sup) == 1 and "'big_suppressed'" in sup[0].message

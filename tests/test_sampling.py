@@ -106,3 +106,64 @@ def test_goss_legacy_boosting_alias():
     bst = lgb.train({"objective": "binary", "boosting": "goss",
                      "num_leaves": 7, "verbosity": -1}, ds, num_boost_round=5)
     assert bst.predict(X).shape == (800,)
+
+
+@pytest.mark.parametrize("shape", [(1000,), (3, 1000)], ids=["binary", "multiclass"])
+def test_goss_device_bag_matches_host_bag(monkeypatch, shape):
+    """Round 8: the device-resident GOSS select must pick the SAME bag and
+    produce the SAME rescaled gradients as the host path, bit for bit —
+    both consume the MT19937 stream identically (choice(n, k) and
+    choice(rest, k) are both permutation(n)[:k]) and score with the same
+    f32 per-class value chain (stable argsort: equal keys keep order)."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.models.sample_strategy import DeviceBag, GOSSStrategy
+
+    rng = np.random.RandomState(5)
+    g = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    h = jnp.asarray((np.abs(rng.randn(*shape)) + 0.1).astype(np.float32))
+    cfg = Config({"data_sample_strategy": "goss", "learning_rate": 0.5,
+                  "top_rate": 0.2, "other_rate": 0.1, "objective": "binary"})
+
+    monkeypatch.setenv("LGBM_TPU_GOSS_DEVICE", "0")
+    bag_h, g_h, h_h = GOSSStrategy(cfg, 1000, None, 1).bagging(3, g, h)
+    monkeypatch.setenv("LGBM_TPU_GOSS_DEVICE", "1")
+    bag_d, g_d, h_d = GOSSStrategy(cfg, 1000, None, 1).bagging(3, g, h)
+
+    assert isinstance(bag_d, DeviceBag) and not isinstance(bag_h, DeviceBag)
+    assert len(bag_d) == len(bag_h) == 300  # 20% top + 10% sampled
+    # both paths emit ascending row ids: host sorts its concat, the device
+    # mask materializes via nonzero
+    np.testing.assert_array_equal(bag_d.indices, np.asarray(bag_h))
+    # rescaled gradient planes are bit-identical (multiplier applied to
+    # the same rows through the same f32 multiply)
+    np.testing.assert_array_equal(np.asarray(g_d), np.asarray(g_h))
+    np.testing.assert_array_equal(np.asarray(h_d), np.asarray(h_h))
+    # mask bookkeeping is consistent with the materialized indices
+    assert int(np.asarray(bag_d.mask).sum()) == bag_d.n_bag
+
+
+def test_goss_device_warmup_and_auto_gate(monkeypatch):
+    """Warm-up iterations return the full bag on both paths, and the auto
+    mode resolves to host on the CPU test backend."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.models.sample_strategy import (GOSSStrategy,
+                                                     use_device_goss)
+
+    for val, want in (("0", False), ("off", False), ("host", False),
+                      ("1", True), ("on", True), ("device", True)):
+        monkeypatch.setenv("LGBM_TPU_GOSS_DEVICE", val)
+        assert use_device_goss() is want, val
+    monkeypatch.setenv("LGBM_TPU_GOSS_DEVICE", "auto")
+    assert use_device_goss() is False  # CPU backend: host path
+
+    monkeypatch.setenv("LGBM_TPU_GOSS_DEVICE", "1")
+    cfg = Config({"data_sample_strategy": "goss", "learning_rate": 0.5,
+                  "top_rate": 0.2, "other_rate": 0.1, "objective": "binary"})
+    strat = GOSSStrategy(cfg, 1000, None, 1)
+    g = jnp.ones(1000, jnp.float32)
+    bag, _, _ = strat.bagging(0, g, g)  # warm-up: 0 < 1/0.5
+    assert bag is None
