@@ -42,6 +42,7 @@ import time
 from typing import Any, Callable, List, Optional, Tuple
 
 from .. import telemetry, tracing
+from ..utils import sanitize
 from ..utils.log import Log
 from ..utils.timer import global_timer
 
@@ -269,6 +270,11 @@ class ElasticRuntime:
             telemetry.emit("heartbeat", iteration=int(iteration),
                            token=got, world=world, rank=self.rank)
         if got == world:
+            # the heartbeat slot doubles as the sanitizer's collective-
+            # order sync point: every rank is here in lockstep, so the
+            # allgathered fingerprints compare like-for-like
+            if sanitize.enabled():
+                sanitize.check_collective_order()
             return True
         last_good = int(iteration) if self.watchdog is None else max(
             0, int(iteration))
@@ -291,6 +297,7 @@ class ElasticRuntime:
             return self._hb or None
         import jax
 
+        # graftlint: disable=collective-order -- the windowed heartbeat pull, the one sanctioned rank-dependent gate: process_count()/device count are uniform across the gang, so every rank takes the same arm — single-process runs skip the psum by construction, multi-process gangs all build it
         if len(jax.devices()) <= 1 and jax.process_count() <= 1:
             self._hb = ()
             return None

@@ -76,12 +76,19 @@ class ModelEntry:
         C = g.num_tree_per_iteration
         cpu = jax.local_devices(backend="cpu")[0]
         with jax.default_device(cpu):
+            # double-checked pack build: the device transfers inside
+            # pack_ensemble must not run under _host_lock (R13) — a slow
+            # pack would stall every concurrent breaker-OPEN request at
+            # the lock instead of at the (idempotent) build
             with self._host_lock:
-                if self._host_pack is None:
-                    self._host_pack = pack_ensemble(
-                        g.models[: self._tree_slice_end()],
-                        dtype=jnp.float32)
                 packed = self._host_pack
+            if packed is None:
+                packed = pack_ensemble(
+                    g.models[: self._tree_slice_end()], dtype=jnp.float32)
+                with self._host_lock:
+                    if self._host_pack is None:
+                        self._host_pack = packed
+                    packed = self._host_pack
             Xd = jax.device_put(
                 np.ascontiguousarray(X, dtype=np.float32), cpu)
             if packed.num_trees > 0:

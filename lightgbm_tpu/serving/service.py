@@ -56,6 +56,9 @@ class PredictionService:
         self._canary_lock = threading.Lock()
         self._canary_promotions = 0
         self._canary_rollbacks = 0
+        # rollback flight-dump recorded under _canary_lock, written after
+        # release (the breaker's _maybe_dump convention, checked by R13)
+        self._pending_dump: Optional[str] = None
 
     # -------------------------------------------------------------- models
 
@@ -125,6 +128,7 @@ class PredictionService:
                 "payload": dict(kwargs),
                 "version": entry.version,
             }
+        self._maybe_dump()
         tracing.note("canary_started", model=name, fraction=float(fraction),
                      promote_after=int(promote_after))
         if telemetry.enabled():
@@ -138,38 +142,54 @@ class PredictionService:
         turn, else None. Breaker pressure observed here rolls the canary
         back before any further traffic reaches it."""
         with self._canary_lock:
-            c = self._canary
-            if c is None or c["model"] != model:
-                return None
-            if self.breaker.info()["state"] != "closed":
-                self._resolve_canary_locked(
-                    False, "breaker pressure during canary window")
-                return None
-            c["seen"] += 1
-            if c["seen"] % c["every"] != 0:
-                return None
-            try:
-                return self.registry.get(c["canary"])
-            except ModelNotFound:
-                self._canary = None
-                return None
+            entry = self._canary_route_locked(model)
+        self._maybe_dump()
+        return entry
+
+    def _canary_route_locked(self, model: str):
+        c = self._canary
+        if c is None or c["model"] != model:
+            return None
+        if self.breaker.info()["state"] != "closed":
+            self._resolve_canary_locked(
+                False, "breaker pressure during canary window")
+            return None
+        c["seen"] += 1
+        if c["seen"] % c["every"] != 0:
+            return None
+        try:
+            return self.registry.get(c["canary"])
+        except ModelNotFound:
+            self._canary = None
+            return None
 
     def _canary_served(self, model: str) -> None:
         with self._canary_lock:
             c = self._canary
-            if c is None or c["model"] != model:
-                return
-            c["served"] += 1
-            if c["served"] >= c["promote_after"] \
-                    and self.breaker.info()["state"] == "closed":
-                self._resolve_canary_locked(True, "served its window clean")
+            if c is not None and c["model"] == model:
+                c["served"] += 1
+                if c["served"] >= c["promote_after"] \
+                        and self.breaker.info()["state"] == "closed":
+                    self._resolve_canary_locked(True,
+                                                "served its window clean")
+        self._maybe_dump()
 
     def resolve_canary(self, promote: bool, reason: str = "") -> bool:
         """Finish the canary now: promote the candidate to the primary
         slot, or roll it back and keep serving the current model. Returns
         False when no canary is active."""
         with self._canary_lock:
-            return self._resolve_canary_locked(promote, reason)
+            out = self._resolve_canary_locked(promote, reason)
+        self._maybe_dump()
+        return out
+
+    def _maybe_dump(self) -> None:
+        """Write the flight dump a locked canary transition recorded.
+        MUST be called with _canary_lock released: dump_flight does file
+        I/O (R13 polices this)."""
+        tag, self._pending_dump = self._pending_dump, None
+        if tag is not None:
+            tracing.dump_flight(tag)
 
     def _resolve_canary_locked(self, promote: bool, reason: str) -> bool:
         c = self._canary
@@ -200,7 +220,8 @@ class PredictionService:
             if telemetry.enabled():
                 telemetry.emit("canary_rolled_back", model=c["model"],
                                served=c["served"], reason=reason)
-            tracing.dump_flight("canary_rollback")
+            # recorded here, written by the caller after the lock drops
+            self._pending_dump = "canary_rollback"
         return True
 
     def canary_info(self) -> Dict[str, Any]:

@@ -272,6 +272,16 @@ class DriftMonitor:
         self._layout = None
         self._rows_since_check = 0
         self.scores: Dict[int, Dict[str, float]] = {}
+        # flight-dump tag recorded under the caller's lock, written by
+        # flush_pending() once the lock is released
+        self._pending_dump: Optional[str] = None
+
+    def flush_pending(self) -> None:
+        """Write the flight dump a locked _check() recorded. Callers MUST
+        hold no lock here — dump_flight does file I/O (R13)."""
+        tag, self._pending_dump = self._pending_dump, None
+        if tag is not None:
+            tracing.dump_flight(tag)
 
     # ---------------------------------------------------------- observe
 
@@ -395,7 +405,11 @@ class DriftMonitor:
                                psi=round(worst_psi, 6),
                                edge_overflow=round(worst_edge, 6),
                                threshold=self.threshold)
-            tracing.dump_flight("drift_alarm")
+            # observe() runs under the ingest store's push lock; the
+            # postmortem dump does file I/O, so record it here and let the
+            # store write it after release (breaker _maybe_dump
+            # convention, R13)
+            self._pending_dump = "drift_alarm"
 
     # ---------------------------------------------------------- refresh
 

@@ -143,6 +143,7 @@ class RowBlockStore:
                 raise ValueError(
                     f"pushed block has {block.shape[1]} features, "
                     f"store expects {self.n_features}")
+            # graftlint: disable=lock-discipline -- chaos-path only: _emit_fault flight-dumps solely when an injected fault fires; production runs have no fault plan installed, so the steady-state path under this lock never touches the filesystem
             block = faults.maybe_shift_block(block, self.total_rows)
             if self._drift is not None:
                 self._drift.observe(block, self._layout)
@@ -159,6 +160,7 @@ class RowBlockStore:
                 self._raw_blocks.append(block)
                 self._buffered = getattr(self, "_buffered", 0) + block.shape[0]
                 if self._buffered >= self.bin_sample_rows:
+                    # graftlint: disable=lock-discipline -- one-shot layout fit: runs exactly once per stream when the bin sample fills; the forced-bins file read inside Dataset._fit_layout is part of that single fit and must stay atomic with the drain it guards
                     self._fit_and_drain()
             else:
                 self._bin_blocks.append(
@@ -166,6 +168,8 @@ class RowBlockStore:
             self.total_rows += block.shape[0]
             global_timer.add_count("stream_ingest_rows", block.shape[0])
             global_timer.add_count("stream_ingest_bytes", block.nbytes)
+        if self._drift is not None:
+            self._drift.flush_pending()  # drift-alarm dump, outside _lock
         return self
 
     def push_csr(self, indptr, indices, values, num_col: int,
@@ -294,6 +298,7 @@ class RowBlockStore:
         trainer's crash-consistent refit watermark); default is every row
         pushed so far. The store remains open for further pushes."""
         with self._lock:
+            # graftlint: disable=lock-discipline -- one-shot layout fit (see push_rows): only a finalize racing the very first sample fill pays it, and it must stay atomic with the snapshot
             layout = self._require_layout()
             n = self.total_rows if num_rows is None else int(num_rows)
             if n > self.total_rows:

@@ -19,6 +19,20 @@ on a real run —
   `SYNC_FREE` assert zero syncs: any counted sync while such a scope is
   open raises `SyncInScopeError` naming the scope and the sync kind.
 
+* **Collective-order cross-check.** The dynamic oracle for graftlint
+  R12: when enabled, `jax.lax.psum` / `psum_scatter` / `all_gather` are
+  wrapped to record each (op, axis_name) the process TRACES, as a
+  deterministic rolling CRC per step. `check_collective_order()` — called
+  from the elastic heartbeat's existing sync slot and directly by tests —
+  all-gathers the per-rank prefix fingerprints and raises a typed
+  `CollectiveOrderError(rank, first_divergent_op)` naming the first op
+  where this rank's sequence left the gang's. Trace-time recording is
+  deliberate: it is sync-free (R12's sequences are trace properties), and
+  a rank that traces a collective the others never trace is exactly the
+  static rule's deadlock — caught here before the mesh hangs. A
+  re-executed cached jit does not re-trace, so sequences are compared per
+  distinct traced program, not per dispatch.
+
 Known gap: `np.asarray(arr)` reaches the host through the buffer protocol
 without calling any patchable `jax.Array` method (patching `__array__` on
 ArrayImpl does not intercept it), so asarray pulls are invisible to the
@@ -33,8 +47,9 @@ function call and an env lookup per tree dispatch.
 from __future__ import annotations
 
 import os
+import zlib
 from collections import defaultdict
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .timer import global_timer
 
@@ -47,6 +62,21 @@ class SyncInScopeError(RuntimeError):
     """A device sync happened inside a scope declared sync-free."""
 
 
+class CollectiveOrderError(RuntimeError):
+    """This rank's traced collective sequence diverged from the gang's.
+
+    `rank` is the process that detected the divergence (the raiser),
+    `first_divergent_op` names this rank's op at the first step where the
+    prefix fingerprints disagree ("<none>" when this rank posted fewer
+    collectives than the others)."""
+
+    def __init__(self, message: str, rank: int = -1,
+                 first_divergent_op: str = "") -> None:
+        super().__init__(message)
+        self.rank = int(rank)
+        self.first_divergent_op = first_divergent_op
+
+
 # scopes asserted to perform ZERO countable device syncs while open
 SYNC_FREE = {"tree_device", "goss_device_select"}
 
@@ -57,6 +87,16 @@ _orig: Dict[str, Callable] = {}
 _poisoned: Dict[int, Tuple[Any, str]] = {}
 _sync_counts: Dict[str, Dict[str, int]] = defaultdict(
     lambda: defaultdict(int))
+# traced collective sequence: (op, axis_repr) in trace order, plus the
+# rolling CRC after each step (process-independent: zlib.crc32, no string
+# hash salting)
+_collective_seq: List[Tuple[str, str]] = []
+_collective_crcs: List[int] = []
+# prefix slots exchanged by check_collective_order: enough that real
+# divergence (which appears at the first differing op) is always visible
+_FP_SLOTS = 32
+
+_COLLECTIVE_OPS = ("psum", "psum_scatter", "all_gather")
 
 
 def enabled() -> bool:
@@ -86,9 +126,12 @@ def clear_override() -> None:
 
 
 def reset() -> None:
-    """Drop the poison registry and sync counters (between test cases)."""
+    """Drop the poison registry, sync counters and collective sequence
+    (between test cases)."""
     _poisoned.clear()
     _sync_counts.clear()
+    _collective_seq.clear()
+    _collective_crcs.clear()
 
 
 def sync_counts() -> Dict[str, Dict[str, int]]:
@@ -151,7 +194,120 @@ def _install() -> None:
                  "__bool__", "__float__", "__int__"):
         _orig[name] = getattr(ArrayImpl, name)
         setattr(ArrayImpl, name, _counted(name))
+
+    import jax
+
+    def _probed(op: str):
+        orig = _orig["lax." + op]
+
+        def wrapper(x, axis_name=None, *args, **kwargs):
+            if axis_name is None and "axis_name" in kwargs:
+                axis_name = kwargs["axis_name"]
+            if enabled():
+                _note_collective(op, axis_name)
+            if axis_name is None:
+                return orig(x, *args, **kwargs)
+            return orig(x, axis_name, *args, **kwargs)
+
+        wrapper.__name__ = op
+        return wrapper
+
+    for op in _COLLECTIVE_OPS:
+        _orig["lax." + op] = getattr(jax.lax, op)
+        setattr(jax.lax, op, _probed(op))
     _installed = True
+
+
+def _note_collective(op: str, axis_name: Any) -> None:
+    """Record one traced collective: append (op, axis) and roll the CRC.
+    Runs at TRACE time inside jit, which is host-side and sync-free."""
+    axis = repr(axis_name)
+    _collective_seq.append((op, axis))
+    prev = _collective_crcs[-1] if _collective_crcs else 0
+    step = ("%s@%s" % (op, axis)).encode("utf-8")
+    _collective_crcs.append(zlib.crc32(step, prev) & 0xFFFFFFFF)
+
+
+def collective_sequence() -> List[Tuple[str, str]]:
+    """The (op, axis) pairs this process has traced, in order."""
+    return list(_collective_seq)
+
+
+def collective_fingerprint() -> Tuple[int, int]:
+    """(count, rolling CRC of the full sequence) — cheap equality probe."""
+    return (len(_collective_seq),
+            _collective_crcs[-1] if _collective_crcs else 0)
+
+
+def _fingerprint_vector() -> "Any":
+    """[count, crc_1..crc_K]: the per-rank row exchanged by the check.
+    Slot i holds the CRC of the first i+1 ops (0 when fewer were traced),
+    so the first differing slot IS the first divergent op index."""
+    import numpy as np
+
+    vec = np.zeros((_FP_SLOTS + 1,), dtype=np.uint32)
+    vec[0] = min(len(_collective_seq), np.iinfo(np.uint32).max)
+    for i, crc in enumerate(_collective_crcs[:_FP_SLOTS]):
+        vec[1 + i] = crc
+    return vec
+
+
+def check_collective_order(gather_fn: Optional[Callable] = None) -> None:
+    """Cross-check the traced collective sequence against every rank.
+
+    Rides the elastic heartbeat's sync slot (heartbeat_sync calls this
+    when the sanitizer is on and the world is multi-process); tests call
+    it directly. `gather_fn(vec) -> [world, len(vec)]` defaults to
+    `multihost_utils.process_allgather` — inject a fake for single-process
+    tests. No-op when disabled or when the gathered world is 1.
+
+    Raises CollectiveOrderError(rank, first_divergent_op) on the first
+    rank whose prefix fingerprints disagree with any other rank's.
+    """
+    if not enabled():
+        return
+    import numpy as np
+
+    mine = _fingerprint_vector()
+    if gather_fn is None:
+        import jax
+        from jax.experimental import multihost_utils
+
+        if jax.process_count() <= 1:
+            return
+        rank = jax.process_index()
+        rows = np.asarray(multihost_utils.process_allgather(mine))
+    else:
+        import jax
+
+        rank = int(getattr(jax, "process_index", lambda: 0)())
+        rows = np.asarray(gather_fn(mine))
+    if rows.ndim != 2 or rows.shape[0] <= 1:
+        return
+    for other in range(rows.shape[0]):
+        if np.array_equal(rows[other], mine):
+            continue
+        # first prefix slot (op index) where this rank and `other` split
+        div = None
+        for i in range(_FP_SLOTS):
+            if rows[other][1 + i] != mine[1 + i]:
+                div = i
+                break
+        if div is None:
+            # prefixes agree through every slot: the counts differ
+            div = min(int(mine[0]), int(rows[other][0]))
+        if div < len(_collective_seq):
+            op = "%s@%s" % _collective_seq[div]
+        else:
+            op = "<none: this rank traced %d collective(s), rank %d "\
+                 "traced %d>" % (int(mine[0]), other, int(rows[other][0]))
+        raise CollectiveOrderError(
+            "collective order divergence: rank %d and rank %d traced "
+            "different collective sequences, first divergent op #%d is "
+            "%s on this rank — every rank must issue the same collectives "
+            "in the same order or the mesh deadlocks (graftlint R12 is "
+            "the static form of this check)" % (rank, other, div, op),
+            rank=rank, first_divergent_op=op)
 
 
 def guard(fn: Callable, donate: Sequence[int], site: str) -> Callable:

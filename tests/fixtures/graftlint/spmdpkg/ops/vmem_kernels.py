@@ -1,0 +1,36 @@
+"""R14 plants: one pallas_call whose double-buffered blocks blow past the
+16 MiB floor, next to a tiled call that fits. Shapes are R3-aligned
+(rows % 8 == 0, cols % 128 == 0) so only the VMEM rule fires.
+"""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+BIG_ROWS = 16384
+BIG_COLS = 4096
+TILE_ROWS = 256
+TILE_COLS = 128
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def oversized_copy(x):
+    return pl.pallas_call(  # R14: 2 x 2 x 256 MiB of blocks vs 16 MiB
+        _copy_kernel,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((BIG_ROWS, BIG_COLS), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((BIG_ROWS, BIG_COLS), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((BIG_ROWS, BIG_COLS), jnp.float32),
+    )(x)
+
+
+def tiled_copy(x):
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(BIG_ROWS // TILE_ROWS, BIG_COLS // TILE_COLS),
+        in_specs=[pl.BlockSpec((TILE_ROWS, TILE_COLS), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((TILE_ROWS, TILE_COLS), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((BIG_ROWS, BIG_COLS), jnp.float32),
+    )(x)

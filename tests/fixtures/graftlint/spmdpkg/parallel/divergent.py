@@ -1,0 +1,50 @@
+"""R12 plants: rank-gated collective arms and a rank-local-bound loop,
+next to the compliant and suppressed shapes. Every psum here is bound to
+an axis, so the R7/R11 axis passes are satisfied — only the collective-
+SEQUENCE summary sees the divergence.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def _sync(x):
+    return jax.lax.psum(x, "data")
+
+
+def rank_gated_sum(x):
+    if jax.process_index() == 0:  # R12(a): only rank 0 posts the psum
+        x = jax.lax.psum(x, "data")
+    return x
+
+
+def early_return_gate(x, rank):
+    if rank != 0:  # R12(a): the implicit else (rest of the block) syncs
+        return x
+    return _sync(x)
+
+
+def uniform_gate(x):
+    if jax.process_index() == 0:  # clean: both arms post the same sequence
+        return jax.lax.psum(x, "data")
+    return jax.lax.psum(x, "data")
+
+
+def per_device_reduce(x):
+    total = jnp.zeros_like(x)
+    for _ in jax.local_devices():  # R12(b): rank-local trip count
+        total = total + jax.lax.psum(x, "data")
+    return total
+
+
+def padded_reduce(x, steps):
+    total = jnp.zeros_like(x)
+    for _ in range(steps):  # clean: trip count is a plain argument
+        total = total + jax.lax.psum(x, "data")
+    return total
+
+
+def single_host_fallback(x):
+    # graftlint: disable=collective-order -- process_count() is uniform across the gang: every rank takes the same arm together
+    if jax.process_count() == 1:
+        return x
+    return _sync(x)

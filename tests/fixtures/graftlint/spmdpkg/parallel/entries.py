@@ -1,0 +1,28 @@
+"""R12(c) plant: one shard-mapped body entered under two different axis
+bindings. R11's union over entry sites is satisfied — 'data' IS bound at
+an entry somewhere — but the 'model'-only entry traces a psum over an
+axis it never binds.
+"""
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .divergent import _sync
+
+
+def _body(x):
+    return jax.lax.psum(x, "data")
+
+
+def enter_data(mesh, x):
+    return shard_map(_body, mesh=mesh, in_specs=(P("data"),),
+                     out_specs=P("data"))(x)
+
+
+def enter_model(mesh, x):
+    return shard_map(_body, mesh=mesh, in_specs=(P("model"),),
+                     out_specs=P("model"))(x)  # R12(c): 'data' unbound here
+
+
+def reuse_helper(x):
+    return _sync(x)

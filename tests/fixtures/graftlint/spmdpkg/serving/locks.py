@@ -1,0 +1,60 @@
+"""R13 plants: jitted dispatch / file I/O / transitive sleep under a held
+lock and an acquisition-order cycle, next to the compliant pending-record
+idiom (record under the lock, act after release) and a reasoned
+suppression.
+"""
+import threading
+import time
+
+import jax
+
+
+@jax.jit
+def _dev_double(x):
+    return x * 2.0
+
+
+def _backoff():
+    time.sleep(0.01)
+
+
+class PlantedServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux = threading.Lock()
+        self._pending = None
+
+    def bad_dispatch(self, x):
+        with self._lock:
+            return _dev_double(x)  # R13: jitted dispatch under _lock
+
+    def bad_io(self, payload):
+        with self._lock:
+            with open("/tmp/spmd_flight.json", "w") as f:  # R13: file I/O
+                f.write(payload)
+
+    def bad_transitive(self):
+        with self._lock:
+            _backoff()  # R13: blocks via time.sleep two frames away
+
+    def order_ab(self):
+        with self._lock:
+            with self._aux:  # R13: cycle edge _lock -> _aux
+                return 1
+
+    def order_ba(self):
+        with self._aux:
+            with self._lock:  # R13: cycle edge _aux -> _lock
+                return 2
+
+    def good_pending(self, payload):
+        with self._lock:
+            self._pending = payload
+        if self._pending is not None:
+            with open("/tmp/spmd_ok.json", "w") as f:  # clean: lock released
+                f.write(self._pending)
+
+    def seeded(self):
+        with self._lock:
+            # graftlint: disable=lock-discipline -- startup-only seed read: bounded, runs once before serving starts
+            return open("/tmp/spmd_seed.json").read()

@@ -1,0 +1,269 @@
+"""graftlint v3 (R12/R13/R14) against the planted SPMD fixture package
+(tests/fixtures/graftlint/spmdpkg): every planted defect — divergent
+collective arms, a rank-local-bound loop, an inconsistent axis entry, a
+lock-order cycle, dispatch/IO under a lock, a VMEM-overflowing
+pallas_call — is caught at its exact line, the adjacent compliant shapes
+stay quiet, and the reasoned suppressions are honored. Plus the
+--changed-only scoping mode and the hardened cache config key.
+"""
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import run_lint
+from tools.graftlint.cache import CacheStore
+from tools.graftlint.core import collect
+from tools.graftlint.rules import RULES
+
+REPO = Path(__file__).resolve().parent.parent
+SPMD = REPO / "tests" / "fixtures" / "graftlint" / "spmdpkg"
+ACTIVE = sorted(r.name for r in RULES)
+
+
+@pytest.fixture(scope="module")
+def result():
+    return run_lint(SPMD)
+
+
+def _hits(result, rule, path=None, suppressed=False):
+    pool = result.suppressed if suppressed else result.violations
+    return [v for v in pool
+            if v.rule == rule and (path is None or v.path == path)]
+
+
+# -- R12(a) rank-dependent branch divergence ------------------------------
+
+def test_r12_rank_gated_arms_flagged(result):
+    bad = _hits(result, "collective-order", "parallel/divergent.py")
+    assert [v.line for v in bad] == [15, 21]
+    assert "[psum@data] vs []" in bad[0].message
+    assert "deadlock the mesh" in bad[0].message
+    # early_return_gate: the implicit else is the rest of the block
+    assert "[] vs [psum@data]" in bad[1].message
+
+
+def test_r12_uniform_arms_stay_quiet(result):
+    # uniform_gate posts the same sequence on both arms (line 26)
+    lines = {v.line for v in _hits(result, "collective-order")}
+    assert 26 not in lines
+
+
+def test_r12_sanctioned_suppression_honored(result):
+    sup = _hits(result, "collective-order", "parallel/divergent.py",
+                suppressed=True)
+    assert [v.line for v in sup] == [48]
+    assert "uniform across the gang" in sup[0].reason
+
+
+# -- R12(b) rank-local loop trip counts -----------------------------------
+
+def test_r12_rank_local_loop_flagged(result):
+    bad = _hits(result, "collective-rank-loop", "parallel/divergent.py")
+    assert [v.line for v in bad] == [34]
+    assert "psum@data" in bad[0].message
+    assert "rank-local data" in bad[0].message
+
+
+def test_r12_global_trip_count_stays_quiet(result):
+    # padded_reduce loops over a plain argument (line 41)
+    assert not [v for v in _hits(result, "collective-rank-loop")
+                if v.line == 41]
+
+
+# -- R12(c) inconsistent axis bindings across entries ---------------------
+
+def test_r12_axis_entry_divergence_flagged(result):
+    bad = _hits(result, "collective-axis-entry", "parallel/entries.py")
+    assert [v.line for v in bad] == [23]
+    assert "binding only ['model']" in bad[0].message
+    assert "uses axis ['data']" in bad[0].message
+
+
+def test_r12_covering_entry_stays_quiet(result):
+    # enter_data binds 'data' (lines 18-19): not an entry finding
+    lines = {v.line for v in _hits(result, "collective-axis-entry")}
+    assert not lines & {18, 19}
+
+
+# -- R13 blocking work under a held lock ----------------------------------
+
+def test_r13_blocking_under_lock_flagged(result):
+    bad = _hits(result, "lock-discipline", "serving/locks.py")
+    assert [v.line for v in bad] == [29, 33, 38]
+    assert "jitted dispatch _dev_double" in bad[0].message
+    assert "file I/O (open)" in bad[1].message
+    # the sleep lives two frames away: the finding names the chain
+    assert "time.sleep at serving/locks.py:18" in bad[2].message
+
+
+def test_r13_pending_record_idiom_stays_quiet(result):
+    # good_pending writes its file AFTER releasing the lock (line 53)
+    lines = {v.line for v in _hits(result, "lock-discipline")}
+    assert 53 not in lines
+
+
+def test_r13_suppression_honored(result):
+    sup = _hits(result, "lock-discipline", "serving/locks.py",
+                suppressed=True)
+    assert [v.line for v in sup] == [60]
+    assert "startup-only" in sup[0].reason
+
+
+# -- R13 acquisition-order cycles -----------------------------------------
+
+def test_r13_lock_order_cycle_both_directions(result):
+    bad = _hits(result, "lock-order-cycle", "serving/locks.py")
+    assert sorted(v.line for v in bad) == [42, 47]
+    assert all("acquisition-order cycle" in v.message for v in bad)
+    assert all("PlantedServer._lock" in v.message
+               and "PlantedServer._aux" in v.message for v in bad)
+
+
+# -- R14 Pallas VMEM budget -----------------------------------------------
+
+def test_r14_oversized_blocks_flagged(result):
+    bad = _hits(result, "pallas-vmem", "ops/vmem_kernels.py")
+    assert [v.line for v in bad] == [20]
+    assert "1024.0 MiB" in bad[0].message
+    assert "16.0 MiB" in bad[0].message
+
+
+def test_r14_tiled_kernel_fits(result):
+    # tiled_copy (line 30) stays under the floor
+    assert len(_hits(result, "pallas-vmem")) == 1
+
+
+def test_r14_perfmodel_budget_is_read_from_the_linted_root(tmp_path):
+    root = tmp_path / "spmdpkg"
+    shutil.copytree(SPMD, root)
+    (root / "perfmodel.py").write_text(
+        "PALLAS_VMEM_DEFAULT_BYTES = 2 * 1024 * 1024 * 1024\n")
+    relaxed = run_lint(root)
+    assert not _hits(relaxed, "pallas-vmem")
+
+
+# -- the production tree stays clean --------------------------------------
+
+def test_product_package_clean_under_v3():
+    res = run_lint(REPO / "lightgbm_tpu",
+                   select=["collective-order", "collective-rank-loop",
+                           "collective-axis-entry", "lock-discipline",
+                           "lock-order-cycle", "pallas-vmem"])
+    assert res.violations == []
+    # the sanctioned R12 suppression (elastic heartbeat) is present
+    assert any(v.path == "parallel/elastic.py"
+               and v.rule == "collective-order"
+               for v in res.suppressed)
+
+
+# -- changed-only scoping -------------------------------------------------
+
+def test_changed_only_restricts_local_rules():
+    # pallas-vmem is file-local: changing only parallel/ must drop it,
+    # while the whole-program R12 findings still run (affected non-empty)
+    res = run_lint(SPMD, changed_only=["parallel/divergent.py"])
+    assert not _hits(res, "pallas-vmem")
+    assert [v.line for v in _hits(res, "collective-order",
+                                  "parallel/divergent.py")] == [15, 21]
+
+
+def test_changed_only_follows_reverse_imports():
+    # entries.py imports divergent.py: changing divergent affects entries,
+    # so entries' file-local findings reappear — but serving/ stays out
+    res = run_lint(SPMD, changed_only=["parallel/divergent.py"])
+    full = run_lint(SPMD)
+    wanted = {(v.rule, v.path, v.line) for v in full.violations
+              if v.path.startswith("parallel/")}
+    got = {(v.rule, v.path, v.line) for v in res.violations
+           if v.path.startswith("parallel/")}
+    assert wanted == got
+
+
+def test_changed_only_empty_set_runs_nothing():
+    res = run_lint(SPMD, changed_only=[])
+    assert res.violations == [] and res.suppressed == []
+
+
+def test_changed_only_cli_against_git(tmp_path):
+    shutil.copytree(SPMD, tmp_path / "spmdpkg")
+    env = {"PYTHONPATH": str(REPO), "HOME": str(tmp_path),
+           "PATH": "/usr/bin:/bin:/usr/local/bin"}
+
+    def git(*args):
+        subprocess.run(("git", "-c", "user.email=t@t", "-c", "user.name=t")
+                       + args, cwd=tmp_path, check=True,
+                       capture_output=True, env=env)
+
+    git("init", "-q")
+    git("add", ".")
+    git("commit", "-q", "-m", "seed")
+
+    cmd = [sys.executable, "-m", "tools.graftlint", "spmdpkg",
+           "--changed-only"]
+    clean = subprocess.run(cmd, cwd=tmp_path, capture_output=True,
+                           text=True, env=env)
+    assert clean.returncode == 0  # nothing changed -> nothing linted
+    assert "0 violation(s)" in clean.stdout
+
+    kernels = tmp_path / "spmdpkg" / "ops" / "vmem_kernels.py"
+    kernels.write_text(kernels.read_text() + "\n# touched\n")
+    touched = subprocess.run(cmd, cwd=tmp_path, capture_output=True,
+                             text=True, env=env)
+    assert touched.returncode == 1
+    assert "ops/vmem_kernels.py:20" in touched.stdout
+    # file-local findings from untouched files are excluded...
+    assert "[collective-axis]" not in touched.stdout
+    # ...but whole-program rules still run over the full package
+    assert "[collective-order]" in touched.stdout
+
+
+# -- cache config key -----------------------------------------------------
+
+def test_cache_key_includes_format_component(tmp_path):
+    root = tmp_path / "spmdpkg"
+    shutil.copytree(SPMD, root)
+    cache_dir = tmp_path / "cache"
+    run_lint(root, cache=CacheStore(root, cache_dir=cache_dir),
+             cache_key_extra="fmt=text")
+    store = CacheStore(root, cache_dir=cache_dir)
+    hit = store.plan(collect(root), ACTIVE, "fmt=text")
+    assert hit[2] is not None  # whole-program served
+    miss = store.plan(collect(root), ACTIVE, "fmt=sarif")
+    assert miss[2] is None and len(miss[1]) == len(collect(root).files)
+
+
+def test_cache_key_uses_canonical_rule_set(tmp_path):
+    # --select R12 and --select by-name spell the same active set: the
+    # canonical key makes them share one cache entry
+    root = tmp_path / "spmdpkg"
+    shutil.copytree(SPMD, root)
+    cache_dir = tmp_path / "cache"
+    by_code = run_lint(root, select=["R12"],
+                       cache=CacheStore(root, cache_dir=cache_dir))
+    by_name = run_lint(root,
+                       select=["collective-order", "collective-rank-loop",
+                               "collective-axis-entry"],
+                       cache=CacheStore(root, cache_dir=cache_dir))
+    assert [v.render() for v in by_name.violations] == \
+           [v.render() for v in by_code.violations]
+    store = CacheStore(root, cache_dir=cache_dir)
+    active = sorted(["collective-order"])
+    hit = store.plan(collect(root), active)
+    assert hit[2] is not None
+
+
+def test_cache_invalidated_by_perfmodel_edit(tmp_path):
+    root = tmp_path / "spmdpkg"
+    shutil.copytree(SPMD, root)
+    (root / "perfmodel.py").write_text("PALLAS_VMEM_DEFAULT_BYTES = 2**24\n")
+    cache_dir = tmp_path / "cache"
+    run_lint(root, cache=CacheStore(root, cache_dir=cache_dir))
+    # editing the R14 config tables must invalidate everything, even
+    # though perfmodel.py is outside the linter's own source tree
+    (root / "perfmodel.py").write_text("PALLAS_VMEM_DEFAULT_BYTES = 2**25\n")
+    store = CacheStore(root, cache_dir=cache_dir)
+    cached, invalid, wp = store.plan(collect(root), ACTIVE)
+    assert wp is None

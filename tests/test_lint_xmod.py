@@ -19,10 +19,14 @@ from tools.graftlint import run_lint
 from tools.graftlint.cache import CacheStore
 from tools.graftlint.callgraph import get_callgraph, import_deps
 from tools.graftlint.core import collect
+from tools.graftlint.rules import RULES
 from tools.graftlint.sarif import to_sarif
 
 REPO = Path(__file__).resolve().parent.parent
 XPKG = REPO / "tests" / "fixtures" / "graftlint" / "xpkg"
+# the cache key holds the canonical ACTIVE rule set; a default run_lint
+# activates every registered rule
+ACTIVE = sorted(r.name for r in RULES)
 
 
 @pytest.fixture(scope="module")
@@ -171,7 +175,7 @@ def test_cache_full_hit_reproduces_results(tmp_path):
     # an unchanged tree is a full hit: nothing invalid, whole-program
     # findings served from cache
     cached, invalid, wp = CacheStore(root, cache_dir=cache_dir).plan(
-        collect(root))
+        collect(root), ACTIVE)
     assert not invalid
     assert wp is not None
 
@@ -189,7 +193,7 @@ def test_cache_cross_file_invalidation(tmp_path):
     kernels.write_text(kernels.read_text().replace(
         "@jax.jit\ndef scale", "def scale"))
     cached, invalid, wp = CacheStore(root, cache_dir=cache_dir).plan(
-        collect(root))
+        collect(root), ACTIVE)
     assert wp is None  # a changed tree can't serve whole-program findings
     assert "ops/kernels.py" in invalid
     assert "treelearner/stats.py" in invalid  # reverse dependency
@@ -206,7 +210,7 @@ def test_cache_invalidated_by_rules_digest(tmp_path, monkeypatch):
     run_lint(root, cache=CacheStore(root, cache_dir=cache_dir))
     store = CacheStore(root, cache_dir=cache_dir)
     monkeypatch.setattr(store, "_rules_digest", "different")
-    cached, invalid, wp = store.plan(collect(root))
+    cached, invalid, wp = store.plan(collect(root), ACTIVE)
     assert wp is None and len(invalid) == len(collect(root).files)
 
 

@@ -2,11 +2,19 @@
 
 Unit level: the poison registry raises on any host access to a donated
 reference (naming the donation site), sync counters attribute to the
-innermost timer scope, and sync-free scopes reject counted syncs.
-Integration level: a full device-learner train under the sanitizer is
-BIT-identical to one without it — the sanitizer observes, never perturbs.
+innermost timer scope, sync-free scopes reject counted syncs, and the
+collective-order probe records traced collectives and raises a typed
+CollectiveOrderError naming the first divergent op (graftlint R12's
+dynamic oracle). Integration level: a full device-learner train under
+the sanitizer is BIT-identical to one without it — the sanitizer
+observes, never perturbs — and a real two-process gloo gang with a
+planted rank-divergent psum is caught at the cross-check.
 """
-from functools import partial
+import os
+import socket
+import subprocess
+import sys
+from functools import lru_cache, partial
 
 import numpy as np
 import pytest
@@ -123,6 +131,144 @@ def test_sync_free_scope_raises():
     sanitize.reset()
     with global_timer.scope("tree_replay"):
         assert x[0].item() == 1.0
+
+
+@lru_cache(maxsize=None)
+def _psum_fn(axis):
+    @jax.jit
+    def f(x):
+        return jax.vmap(lambda v: jax.lax.psum(v, axis), axis_name=axis)(x)
+
+    return f
+
+
+def _traced_psum(axis):
+    return _psum_fn(axis)(jnp.ones((4, 2), jnp.float32))
+
+
+def test_collective_probe_records_traced_sequence():
+    sanitize.enable()
+    sanitize.reset()
+    _traced_psum("batch")
+    assert sanitize.collective_sequence() == [("psum", "'batch'")]
+    count, crc = sanitize.collective_fingerprint()
+    assert count == 1 and crc != 0
+    # a cached jit re-dispatches without re-tracing: the sequence is a
+    # per-traced-program property and must not grow (documented caveat)
+    _traced_psum("batch")
+    assert sanitize.collective_sequence() == [("psum", "'batch'")]
+
+
+def test_collective_probe_inert_when_disabled():
+    sanitize.enable()  # installs the patches...
+    sanitize.disable()  # ...which must now pass through silently
+    sanitize.reset()
+    _traced_psum("quiet")
+    assert sanitize.collective_sequence() == []
+    sanitize.check_collective_order(gather_fn=lambda vec: 1 / 0)  # no-op
+
+
+def test_collective_order_check_names_first_divergent_op():
+    sanitize.enable()
+    sanitize.reset()
+    _traced_psum("a")
+    _traced_psum("b")
+
+    def matching(vec):
+        return np.stack([vec, vec])
+
+    sanitize.check_collective_order(gather_fn=matching)  # agreement: quiet
+
+    def divergent(vec):
+        other = np.array(vec, copy=True)
+        other[2] ^= 0x5A5A  # the fake peer's SECOND op differs
+        return np.stack([vec, other])
+
+    with pytest.raises(sanitize.CollectiveOrderError) as exc:
+        sanitize.check_collective_order(gather_fn=divergent)
+    assert exc.value.first_divergent_op == "psum@'b'"
+    assert exc.value.rank == 0
+    assert "op #1" in str(exc.value)
+
+
+def test_collective_order_check_flags_count_mismatch():
+    sanitize.enable()
+    sanitize.reset()
+    _traced_psum("only")
+
+    def longer_peer(vec):
+        other = np.array(vec, copy=True)
+        other[0] += 1  # the peer traced one extra collective...
+        other[2] = 12345  # ...so its second prefix slot is non-zero
+        return np.stack([vec, other])
+
+    with pytest.raises(sanitize.CollectiveOrderError) as exc:
+        sanitize.check_collective_order(gather_fn=longer_peer)
+    assert exc.value.first_divergent_op.startswith("<none:")
+    assert "traced 1 collective(s)" in exc.value.first_divergent_op
+
+
+_ORDER_WORKER = r"""
+import os, sys
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4").strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=nproc, process_id=pid)
+import jax.numpy as jnp
+from lightgbm_tpu.utils import sanitize
+sanitize.enable()
+
+def traced(axis):
+    @jax.jit
+    def f(x):
+        return jax.vmap(lambda v: jax.lax.psum(v, axis), axis_name=axis)(x)
+    return f(jnp.ones((4, 2), jnp.float32))
+
+traced("data")            # every rank posts this one
+if pid == 1:
+    traced("extra")       # the planted defect: rank 1 traces a stray psum
+try:
+    sanitize.check_collective_order()
+except sanitize.CollectiveOrderError as e:
+    print("CAUGHT CollectiveOrderError rank=%d op=%s"
+          % (e.rank, e.first_divergent_op))
+    sys.exit(0)
+print("NO DIVERGENCE DETECTED")
+sys.exit(1)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_collective_order_divergence_across_gloo_gang():
+    """Two real jax.distributed processes; rank 1 traces a psum the gang
+    never posts. The heartbeat-slot cross-check must catch it on BOTH
+    ranks: rank 1 names the stray op, rank 0 reports the count gap."""
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _ORDER_WORKER, str(pid), "2", str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for pid in (0, 1)]
+    outputs = [p.communicate(timeout=600)[0] for p in procs]
+    for p, o in zip(procs, outputs):
+        assert p.returncode == 0, f"worker failed:\n{o[-3000:]}"
+    assert "CAUGHT CollectiveOrderError rank=1 op=psum@'extra'" in outputs[1]
+    assert "CAUGHT CollectiveOrderError rank=0 op=<none:" in outputs[0]
 
 
 def _device_booster(X, y, params, n_iters):

@@ -13,9 +13,14 @@ RULE EXECUTION:
   and are served from cache only on a fully-unchanged tree.
 
 Every entry is keyed on a digest of the linter's own source tree
-(`rules_digest`) plus the canonicalized select/ignore filters, so editing
-a rule or changing the rule set invalidates everything — stale findings
-can never outlive the code that produced them.
+(`rules_digest`) plus a config key: the CANONICAL active rule set (after
+R-code family expansion — `--select R1` and `--select jit-sync,jit-sync-xmod`
+hash identically), the CLI's output format, and a digest of the linted
+root's `perfmodel.py` (the R14 VMEM capacity/bound tables live there, so
+editing a budget must invalidate cached Pallas findings even though the
+file is outside the linter's own tree). Raw select/ignore tokens are NOT
+part of the key any more — keying on unexpanded aliases let two spellings
+of the same rule set miss each other's entries.
 
 Cache location: `.graftlint_cache/<sha16-of-root>.json` under the working
 directory (one file per linted root). Writes are atomic (tmp + rename);
@@ -35,7 +40,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .core import Package, Violation
 
-_VERSION = 1
+_VERSION = 2
 
 
 def _sha(data: str) -> str:
@@ -61,10 +66,24 @@ def rules_digest() -> str:
     return h.hexdigest()[:32]
 
 
-def _canon_filters(select: Optional[Sequence[str]],
-                   ignore: Optional[Sequence[str]]) -> List[List[str]]:
-    return [sorted(select) if select else [],
-            sorted(ignore) if ignore else []]
+def config_key(root: Path, active: Sequence[str], extra: str = "") -> list:
+    """The run-configuration component of the cache key.
+
+    `active` is the canonical post-expansion rule-name set actually run
+    (run_lint computes it), `extra` carries CLI-level knobs that shape the
+    recorded findings or their rendering (currently the output format),
+    and the trailing element digests `<root>/perfmodel.py` when present —
+    rule configuration sourced from the linted tree rather than the
+    linter's own tree."""
+    perf_digest = ""
+    try:
+        perf = Path(root) / "perfmodel.py"
+        if perf.is_file():
+            perf_digest = file_digest(
+                perf.read_text(encoding="utf-8", errors="surrogateescape"))
+    except OSError:
+        pass
+    return [sorted(active), extra, perf_digest]
 
 
 class CacheStore:
@@ -82,7 +101,7 @@ class CacheStore:
         self._rules_digest = rules_digest()
 
     # -- load / validate ---------------------------------------------------
-    def _load(self, filters: List[List[str]]) -> Optional[dict]:
+    def _load(self, config: list) -> Optional[dict]:
         try:
             data = json.loads(self.path.read_text())
         except (OSError, ValueError):
@@ -91,19 +110,20 @@ class CacheStore:
             return None
         if data.get("rules_digest") != self._rules_digest:
             return None
-        if data.get("filters") != filters:
+        if data.get("config") != config:
             return None
         return data
 
     def plan(self, pkg: Package,
-             select: Optional[Sequence[str]] = None,
-             ignore: Optional[Sequence[str]] = None,
+             active: Sequence[str] = (),
+             extra: str = "",
              ) -> Tuple[Dict[str, List[Violation]], Set[str],
                         Optional[List[Violation]]]:
         """Returns (cached_local_findings_by_relpath, invalid_relpaths,
-        cached_whole_program_findings_or_None)."""
+        cached_whole_program_findings_or_None). `active` is the canonical
+        rule-name set being run; `extra` is the CLI's format component."""
         digests = {ctx.relpath: file_digest(ctx.source) for ctx in pkg.files}
-        data = self._load(_canon_filters(select, ignore))
+        data = self._load(config_key(pkg.root, active, extra))
         if data is None:
             return {}, set(digests), None
         entries = data.get("files", {})
@@ -131,8 +151,8 @@ class CacheStore:
     def save(self, pkg: Package,
              local_by_file: Dict[str, List[Violation]],
              whole_program: List[Violation],
-             select: Optional[Sequence[str]] = None,
-             ignore: Optional[Sequence[str]] = None) -> None:
+             active: Sequence[str] = (),
+             extra: str = "") -> None:
         from .callgraph import import_deps
 
         digests = {ctx.relpath: file_digest(ctx.source) for ctx in pkg.files}
@@ -140,7 +160,7 @@ class CacheStore:
         data = {
             "version": _VERSION,
             "rules_digest": self._rules_digest,
-            "filters": _canon_filters(select, ignore),
+            "config": config_key(pkg.root, active, extra),
             "files": {
                 rel: {
                     "digest": digests[rel],

@@ -15,6 +15,30 @@ from .core import run_lint
 from .rules import RULES, EXTRA_IDS, rule_codes
 
 
+def _changed_paths(base: str):
+    """Absolute paths of files changed vs `base` (plus untracked files),
+    or None when git is unavailable / not a repository."""
+    import subprocess
+
+    def git(*cmd: str) -> Optional[str]:
+        try:
+            proc = subprocess.run(("git",) + cmd, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return proc.stdout if proc.returncode == 0 else None
+
+    top = git("rev-parse", "--show-toplevel")
+    diff = git("diff", "--name-only", base, "--")
+    if top is None or diff is None:
+        return None
+    untracked = git("ls-files", "--others", "--exclude-standard") or ""
+    root = Path(top.strip())
+    return {(root / line.strip()).resolve()
+            for line in diff.splitlines() + untracked.splitlines()
+            if line.strip()}
+
+
 def _list_rules() -> str:
     lines = ["graftlint rules:"]
     for rule in RULES:
@@ -58,6 +82,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="disable the .graftlint_cache/ incremental "
                              "cache (the CLI caches by default; the "
                              "run_lint library API never does)")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="lint only files changed vs --base (plus "
+                             "everything that transitively imports them); "
+                             "whole-program rules still run when any "
+                             "affected file exists. Implies --no-cache.")
+    parser.add_argument("--base", default="HEAD",
+                        help="git ref --changed-only diffs against "
+                             "(default: HEAD; untracked files always "
+                             "count as changed)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -82,6 +115,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     ignore = [t.strip() for t in args.ignore.split(",")] if args.ignore \
         else None
 
+    changed_abs = None
+    if args.changed_only:
+        changed_abs = _changed_paths(args.base)
+        if changed_abs is None:
+            print("error: --changed-only needs a git checkout (git diff "
+                  "--name-only %s failed)" % args.base, file=sys.stderr)
+            return 2
+
     failed = False
     all_violations = []
     all_suppressed = []
@@ -90,12 +131,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not p.exists():
             print("error: no such path: %s" % path, file=sys.stderr)
             return 2
+        changed_rel = None
+        if changed_abs is not None:
+            rp = p.resolve()
+            if p.is_file():
+                changed_rel = [p.name] if rp in changed_abs else []
+            else:
+                changed_rel = []
+                for c in changed_abs:
+                    try:
+                        changed_rel.append(c.relative_to(rp).as_posix())
+                    except ValueError:
+                        continue
         store = None
-        if not args.no_cache:
+        if not args.no_cache and changed_rel is None:
             from .cache import CacheStore
 
             store = CacheStore(p)
-        result = run_lint(p, select=select, ignore=ignore, cache=store)
+        result = run_lint(p, select=select, ignore=ignore, cache=store,
+                          cache_key_extra="fmt=%s" % args.fmt,
+                          changed_only=changed_rel)
         if args.fmt == "sarif":
             prefix = path.rstrip("/") if p.is_dir() else ""
             for v in result.violations:

@@ -182,7 +182,8 @@ def collect(root: Path) -> Package:
 
 def run_lint(root, select: Optional[Sequence[str]] = None,
              ignore: Optional[Sequence[str]] = None,
-             cache=None) -> LintResult:
+             cache=None, cache_key_extra: str = "",
+             changed_only: Optional[Sequence[str]] = None) -> LintResult:
     """Lint every .py under `root` (a package directory or single file).
 
     select/ignore take rule names or R-codes. Suppression directives are
@@ -193,6 +194,17 @@ def run_lint(root, select: Optional[Sequence[str]] = None,
     files whose content (and import closure) is unchanged, and the
     whole-program rules are served from cache on a fully-unchanged tree.
     The library default is no cache — only the CLI opts in.
+    `cache_key_extra` folds CLI-level configuration (output format) into
+    the cache key.
+
+    `changed_only` (a collection of relpaths under `root`) restricts the
+    run to the AFFECTED set: the changed files plus every file whose
+    transitive in-package import closure intersects them. File-local
+    rules, parse/directive seeding, and suppression accounting cover only
+    affected files; whole-program rules run — over the full package, they
+    need the complete call graph — iff the affected set is non-empty.
+    Changed-only runs never read or write the cache: their findings are a
+    subset and would poison full-run entries.
     """
     from .rules import RULES, code_families, rule_codes
 
@@ -214,8 +226,24 @@ def run_lint(root, select: Optional[Sequence[str]] = None,
     ignored = _canon(ignore) if ignore else set()
 
     pkg = collect(Path(root))
+
+    # changed-only mode: affected = changed files + their reverse import
+    # closure (anything whose transitive deps include a changed file)
+    affected: Optional[Set[str]] = None
+    if changed_only is not None:
+        from .callgraph import import_deps
+
+        changed = set(changed_only)
+        deps = import_deps(pkg)
+        affected = {ctx.relpath for ctx in pkg.files
+                    if ctx.relpath in changed
+                    or changed & deps.get(ctx.relpath, set())}
+        cache = None  # a partial run must never feed the full-run cache
+
     raw: List[Violation] = []
     for ctx in pkg.files:
+        if affected is not None and ctx.relpath not in affected:
+            continue
         if ctx.parse_error is not None:
             raw.append(Violation("parse-error", "E0", ctx.relpath, 1, 0,
                                  ctx.parse_error))
@@ -224,14 +252,20 @@ def run_lint(root, select: Optional[Sequence[str]] = None,
     active = [r for r in RULES
               if (selected is None or r.name in selected)
               and r.name not in ignored]
+    active_names = sorted(r.name for r in active)
     local_rules = [r for r in active if not r.whole_program]
     wp_rules = [r for r in active if r.whole_program]
+    if affected is not None and not affected:
+        wp_rules = []  # nothing changed reaches the call graph
 
     if cache is not None:
-        cached_local, invalid, cached_wp = cache.plan(pkg, select, ignore)
+        cached_local, invalid, cached_wp = \
+            cache.plan(pkg, active_names, cache_key_extra)
     else:
         cached_local, invalid, cached_wp = \
             {}, {ctx.relpath for ctx in pkg.files}, None
+    if affected is not None:
+        invalid &= affected
 
     # file-local rules: cached findings for unchanged files, a sub-package
     # run over just the invalidated ones
@@ -254,13 +288,16 @@ def run_lint(root, select: Optional[Sequence[str]] = None,
         for rule in wp_rules:
             wp_findings.extend(rule.check(pkg))
 
-    for findings in local_by_file.values():
+    for rel, findings in local_by_file.items():
+        if affected is not None and rel not in affected:
+            continue
         raw.extend(findings)
     raw.extend(wp_findings)
     # a full hit (no invalid files, whole-program served) leaves the cache
     # file already current — skip the save and its call-graph rebuild
     if cache is not None and (invalid or cached_wp is None):
-        cache.save(pkg, local_by_file, wp_findings, select, ignore)
+        cache.save(pkg, local_by_file, wp_findings, active_names,
+                   cache_key_extra)
 
     kept: List[Violation] = []
     suppressed: List[Violation] = []

@@ -13,9 +13,12 @@ from .collective_context import CollectiveContextRule
 from .donation import DonationRule
 from .donation_flow import DonationFlowRule
 from .dtype_discipline import DtypeDisciplineRule
+from .collective_order import CollectiveOrderRule
 from .jit_boundary import JitBoundaryRule
 from .jit_boundary_xmod import JitBoundaryXModRule
+from .lock_discipline import LockDisciplineRule
 from .pallas_rules import PallasRule
+from .pallas_vmem import PallasVmemRule
 from .param_consistency import ParamConsistencyRule
 from .telemetry_hygiene import TelemetryHygieneRule
 from .timer_discipline import TimerDisciplineRule
@@ -34,12 +37,18 @@ RULES: List[Rule] = [
     JitBoundaryXModRule(),
     DonationFlowRule(),
     CollectiveContextRule(),
+    CollectiveOrderRule(),
+    LockDisciplineRule(),
+    PallasVmemRule(),
 ]
 
 # rule name -> R-code for ids emitted by rules beyond their primary name
 EXTRA_IDS: Dict[str, str] = {
     "pallas-prefetch-arity": "R3",
     "pallas-host-op": "R3",
+    "collective-rank-loop": "R12",
+    "collective-axis-entry": "R12",
+    "lock-order-cycle": "R13",
     "bad-suppression": "S1",
     "parse-error": "E0",
 }

@@ -1,0 +1,346 @@
+"""R12 collective-consistency: every rank must issue the same collectives
+in the same order.
+
+R7/R11 prove each collective is *bound to an axis*; nothing proves the
+*sequence* of collectives is rank-invariant — and a rank-divergent
+sequence is the canonical way to hang a pod: one rank enters a psum the
+others never post, the mesh deadlocks until the elastic watchdog fires
+(if it is armed at all). This pass computes an ordered per-function
+collective-sequence summary — (op, axis) pairs, spliced through resolved
+call edges and through shard_map/jit factory wrap sites — as an
+interprocedural fixpoint, then flags three divergence shapes:
+
+* **collective-order** (a): an ``if`` whose test depends on
+  ``jax.process_index()`` / ``jax.process_count()`` / a rank-named value
+  and whose arms yield different collective sequences. A body that
+  terminates (return/raise/break/continue) is compared against the rest
+  of the enclosing block — the early-return gate is the common disguise.
+* **collective-rank-loop** (b): a collective inside a for/while whose
+  iterable or condition derives from rank-local data (process_index,
+  local/addressable device or shard queries, or names assigned from
+  them): the trip count — and so the number of collectives posted —
+  differs per rank.
+* **collective-axis-entry** (c): the same function entered through two
+  wrapper sites with *different* axis bindings where one binding does not
+  cover the axes its collective sequence uses. R11's union over entry
+  sites cannot see this: each axis is bound *somewhere*, just not on
+  every path.
+
+Conservatism notes: process_count-gated single-process fallbacks are
+uniform across a gang in practice but statically indistinguishable from
+rank divergence — such sites carry reasoned suppressions (the elastic
+heartbeat's windowed pull is the sanctioned one). Factory wrap sites
+(``jax.jit(shard_map(body, ...))``) contribute the wrapped body's
+sequence at the wrap line: the build-then-call pattern means the
+collective runs on whichever rank executes the surrounding code path.
+The dynamic oracle for this pass is sanitize.py's collective-order
+cross-check (docs/ROBUSTNESS.md).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..callgraph import CallGraph, Edge, Node, get_callgraph
+from ..core import Package, Violation, dotted_name
+from .base import Rule
+from .collective_axis import _COLLECTIVES, _axis_arg
+
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_CAP = 64  # summary length cap: divergence shows up long before this
+
+# names whose value differs per process: the (a) branch-test markers
+_RANK_CALLS = {"process_index", "process_count"}
+_RANK_NAMES = {"rank", "process_id", "pid"}
+# additionally rank-LOCAL data sources for the (b) loop-bound taint
+_LOCAL_CALLS = {"local_devices", "local_device_count", "addressable_devices"}
+_LOCAL_ATTRS = {"addressable_shards", "addressable_data"}
+
+Seq = Tuple[Tuple[str, str], ...]
+
+
+def _calls_in_order(node: ast.AST):
+    """Pre-order Call nodes in source order; nested defs/lambdas are their
+    own graph nodes and do not run inline, so they are skipped."""
+    if isinstance(node, _DEFS) or isinstance(node, ast.Lambda):
+        return
+    if isinstance(node, ast.Call):
+        yield node
+    for child in ast.iter_child_nodes(node):
+        yield from _calls_in_order(child)
+
+
+def _collective_at(call: ast.Call) -> Optional[Tuple[str, str]]:
+    op = dotted_name(call.func).rsplit(".", 1)[-1]
+    if op not in _COLLECTIVES:
+        return None
+    axis = _axis_arg(call)
+    if isinstance(axis, ast.Constant) and isinstance(axis.value, str):
+        return (op, axis.value)
+    return (op, "?")
+
+
+class _Summaries:
+    """Memoized ordered collective sequences per call-graph node."""
+
+    def __init__(self, graph: CallGraph) -> None:
+        self.graph = graph
+        self.memo: Dict[str, Seq] = {}
+        # per-node: id(Call) -> edges at that site
+        self._by_call: Dict[str, Dict[int, List[Edge]]] = {}
+
+    def edges_at(self, node: Node) -> Dict[int, List[Edge]]:
+        table = self._by_call.get(node.qual)
+        if table is None:
+            table = {}
+            for e in node.edges:
+                if e.call is not None:
+                    table.setdefault(id(e.call), []).append(e)
+            self._by_call[node.qual] = table
+        return table
+
+    def of_node(self, qual: str, visiting: Optional[Set[str]] = None) -> Seq:
+        if qual in self.memo:
+            return self.memo[qual]
+        visiting = visiting if visiting is not None else set()
+        if qual in visiting:
+            return ()  # recursion: the cycle contributes nothing extra
+        node = self.graph.nodes.get(qual)
+        if node is None:
+            return ()
+        visiting.add(qual)
+        if node.node is not None:
+            stmts: Sequence[ast.AST] = node.node.body
+        elif node.ctx.tree is not None:
+            stmts = node.ctx.tree.body
+        else:
+            stmts = ()
+        seq = self.of_stmts(node, stmts, visiting)
+        visiting.discard(qual)
+        self.memo[qual] = seq
+        return seq
+
+    def of_stmts(self, node: Node, stmts: Sequence[ast.AST],
+                 visiting: Set[str]) -> Seq:
+        out: List[Tuple[str, str]] = []
+        by_call = self.edges_at(node)
+        wrapped_once: Set[str] = set()
+        for stmt in stmts:
+            for call in _calls_in_order(stmt):
+                if len(out) >= _CAP:
+                    return tuple(out)
+                own = _collective_at(call)
+                if own is not None:
+                    out.append(own)
+                    continue
+                for e in by_call.get(id(call), ()):
+                    if e.target is None:
+                        continue
+                    if e.kind == "wrap":
+                        # jit(shard_map(body)) factory: body's sequence
+                        # runs where the product is dispatched — splice
+                        # once per wrapped target
+                        if e.target in wrapped_once:
+                            continue
+                        wrapped_once.add(e.target)
+                    out.extend(self.of_node(e.target, visiting))
+                    break  # first resolved candidate keeps it deterministic
+        return tuple(out[:_CAP])
+
+
+def _expr_tainted(expr: ast.AST, tainted: Set[str], local: bool) -> bool:
+    """Does `expr` mention a rank marker (or a name assigned from one)?
+    With local=True the rank-LOCAL data sources count too (loop bounds)."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            last = dotted_name(sub.func).rsplit(".", 1)[-1]
+            if last in _RANK_CALLS or (local and last in _LOCAL_CALLS):
+                return True
+        elif isinstance(sub, ast.Attribute):
+            if sub.attr in _RANK_NAMES or (local and sub.attr in _LOCAL_ATTRS):
+                return True
+        elif isinstance(sub, ast.Name):
+            if sub.id in _RANK_NAMES or sub.id in tainted:
+                return True
+    return False
+
+
+def _tainted_names(fn: ast.AST, local: bool) -> Set[str]:
+    """Names assigned (transitively, two passes) from rank markers inside
+    one function body."""
+    tainted: Set[str] = set()
+    for _ in range(2):
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, _DEFS) and stmt is not fn:
+                continue
+            value = None
+            targets: List[ast.AST] = []
+            if isinstance(stmt, ast.Assign):
+                value, targets = stmt.value, stmt.targets
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                value, targets = stmt.value, [stmt.target]
+            elif isinstance(stmt, ast.AugAssign):
+                value, targets = stmt.value, [stmt.target]
+            if value is None or not _expr_tainted(value, tainted, local):
+                continue
+            for tgt in targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        tainted.add(n.id)
+    return tainted
+
+
+def _terminates(stmts: Sequence[ast.AST]) -> bool:
+    return any(isinstance(s, (ast.Return, ast.Raise, ast.Break, ast.Continue))
+               for s in stmts)
+
+
+def _fmt(seq: Seq) -> str:
+    if not seq:
+        return "[]"
+    return "[" + ", ".join("%s@%s" % (op, ax) for op, ax in seq[:6]) + (
+        ", ..." if len(seq) > 6 else "") + "]"
+
+
+class CollectiveOrderRule(Rule):
+    name = "collective-order"
+    code = "R12"
+    description = ("rank-divergent collective sequence: collectives under "
+                   "process_index/rank-dependent branches, inside "
+                   "rank-local-bound loops, or behind inconsistent axis "
+                   "bindings")
+    scope_prefixes = ("parallel/", "treelearner/", "models/", "ops/")
+    whole_program = True
+
+    def check(self, pkg: Package) -> Iterable[Violation]:
+        graph = get_callgraph(pkg)
+        sums = _Summaries(graph)
+        scoped = {id(c) for c in self.scoped(pkg)}
+        out: List[Violation] = []
+        for qual in sorted(graph.nodes):
+            node = graph.nodes[qual]
+            if node.node is None or id(node.ctx) not in scoped:
+                continue
+            out.extend(self._check_branches(node, sums))
+            out.extend(self._check_loops(node, sums))
+        out.extend(self._check_entries(graph, sums, scoped))
+        return out
+
+    # -- (a) rank-dependent branches with divergent sequences ------------
+    def _check_branches(self, node: Node, sums: _Summaries
+                        ) -> List[Violation]:
+        out: List[Violation] = []
+        tainted = _tainted_names(node.node, local=False)
+        visiting: Set[str] = {node.qual}
+
+        def walk_block(stmts: Sequence[ast.AST]) -> None:
+            for i, st in enumerate(stmts):
+                if isinstance(st, _DEFS):
+                    continue
+                if isinstance(st, ast.If) \
+                        and _expr_tainted(st.test, tainted, local=False):
+                    body_seq = sums.of_stmts(node, st.body, visiting)
+                    if st.orelse:
+                        other: Sequence[ast.AST] = st.orelse
+                    elif _terminates(st.body):
+                        # early-return gate: the implicit else is the rest
+                        # of the enclosing block
+                        other = stmts[i + 1:]
+                    else:
+                        other = ()
+                    else_seq = sums.of_stmts(node, other, visiting)
+                    if body_seq != else_seq:
+                        out.append(self.violation(
+                            node.ctx, st,
+                            "rank-dependent branch: the arms of this "
+                            "process_index/process_count/rank test post "
+                            "different collective sequences (%s vs %s) — "
+                            "ranks taking different arms deadlock the "
+                            "mesh; restructure so every rank posts the "
+                            "same collectives, or suppress with the "
+                            "uniformity argument"
+                            % (_fmt(body_seq), _fmt(else_seq))))
+                for sub in (getattr(st, "body", ()), getattr(st, "orelse", ()),
+                            getattr(st, "finalbody", ())):
+                    if sub:
+                        walk_block(sub)
+                for h in getattr(st, "handlers", ()):
+                    walk_block(h.body)
+
+        walk_block(node.node.body)
+        return out
+
+    # -- (b) collectives inside rank-local-bound loops -------------------
+    def _check_loops(self, node: Node, sums: _Summaries) -> List[Violation]:
+        out: List[Violation] = []
+        tainted = _tainted_names(node.node, local=True)
+        visiting: Set[str] = {node.qual}
+
+        def walk_block(stmts: Sequence[ast.AST]) -> None:
+            for st in stmts:
+                if isinstance(st, _DEFS):
+                    continue
+                bound = None
+                if isinstance(st, ast.For):
+                    bound = st.iter
+                elif isinstance(st, ast.While):
+                    bound = st.test
+                if bound is not None \
+                        and _expr_tainted(bound, tainted, local=True):
+                    seq = sums.of_stmts(node, st.body, visiting)
+                    if seq:
+                        out.append(self.violation(
+                            node.ctx, st,
+                            "collective %s@%s inside a loop whose trip "
+                            "count derives from rank-local data: each "
+                            "rank posts a different number of "
+                            "collectives — hoist the collective out of "
+                            "the loop or pad to a global trip count"
+                            % seq[0], rule="collective-rank-loop"))
+                        continue  # one finding per loop is enough
+                for sub in (getattr(st, "body", ()), getattr(st, "orelse", ()),
+                            getattr(st, "finalbody", ())):
+                    if sub:
+                        walk_block(sub)
+                for h in getattr(st, "handlers", ()):
+                    walk_block(h.body)
+
+        walk_block(node.node.body)
+        return out
+
+    # -- (c) inconsistent axis bindings across entry sites ---------------
+    def _check_entries(self, graph: CallGraph, sums: _Summaries,
+                       scoped: Set[int]) -> List[Violation]:
+        # target qual -> list of (caller node, edge) with a wrapper binding
+        entries: Dict[str, List[Tuple[Node, Edge]]] = {}
+        for node in graph.nodes.values():
+            for e in node.edges:
+                if e.target is not None and e.axes and e.call is not None:
+                    entries.setdefault(e.target, []).append((node, e))
+        out: List[Violation] = []
+        seen: Set[Tuple[str, int]] = set()
+        for target in sorted(entries):
+            sites = entries[target]
+            bindings = {frozenset(e.axes) for _, e in sites}
+            if len(bindings) < 2:
+                continue
+            used = {ax for _, ax in sums.of_node(target) if ax != "?"}
+            if not used:
+                continue
+            for caller, e in sites:
+                missing = used - e.axes
+                if not missing or id(caller.ctx) not in scoped:
+                    continue
+                key = (caller.ctx.relpath, e.call.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(self.violation(
+                    caller.ctx, e.call,
+                    "%r is entered here binding only %s, but its "
+                    "collective sequence uses axis %s (bound at other "
+                    "entry sites): the trace through this entry posts a "
+                    "different collective sequence than the others"
+                    % (target, sorted(e.axes), sorted(missing)),
+                    rule="collective-axis-entry"))
+        return out

@@ -1,0 +1,469 @@
+"""R13 lock-discipline: acquisition-order cycles and blocking work under
+a held lock, checked over the threaded serving/telemetry/tracing/
+streaming layer.
+
+PR 11 established the convention by hand: the breaker records a pending
+flight-dump under its lock and writes the file *after* releasing
+(`_maybe_dump`), the registry parses model files outside `_lock`, the
+batcher wakes waiters only through its own Condition. Until now those
+were comments. This pass makes them checked invariants:
+
+* **lock-discipline** (primary): any blocking operation reached while a
+  lock is held — device dispatch (a call whose target is jit-wrapped),
+  ``block_until_ready``, ``np.asarray`` on a value produced by a device
+  dispatch in the same function, file I/O (``open``/``os.makedirs``/
+  ``os.replace``/``shutil``), ``time.sleep``, and ``Event.wait``.
+  Blocking-ness propagates bottom-up over the whole-package call graph,
+  so ``push_rows -> observe -> dump_flight`` is caught even though the
+  ``open`` lives two modules away; the finding anchors at the call made
+  under the lock and names the chain.
+* **lock-order-cycle**: the acquisition-order graph (with-statements and
+  acquire/release, nested directly or through calls) must be acyclic;
+  re-acquiring a non-reentrant lock is the one-node cycle.
+
+Policy exemptions, each load-bearing and documented in docs/LINTING.md:
+``telemetry.emit`` (amortized — it flushes its JSONL once per 512 events
+and is called on hot paths by design), the checkpoint atomic writers
+(``atomic_write_text``/``atomic_write_bytes``/``atomic_open`` — bounded,
+fsync-free by default, and the sanctioned way to touch the filesystem),
+and ``Condition.wait`` on a condition constructed over the lock being
+held (that is what conditions are for; the registry of
+``threading.Condition(self._lock)`` associations is built from the same
+scan that finds the locks). Unresolvable calls contribute nothing —
+consistent with the call graph's may-call conservatism, the rule flags
+only what it can prove.
+
+Locks are discovered in the scoped files only (``serving/``,
+``streaming/``, ``telemetry.py``, ``tracing.py``); blocking effects are
+computed package-wide so a scoped lock region calling into ``ops/`` is
+still seen dispatching.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..callgraph import CallGraph, Edge, Node, _own_calls, get_callgraph
+from ..core import Package, Violation, dotted_name
+from .base import Rule
+
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+
+# quals (module, bare name) treated as non-blocking by documented policy
+_POLICY_NONBLOCKING = {
+    ("telemetry", "emit"), ("telemetry", "TelemetrySession.emit"),
+    ("checkpoint", "atomic_open"), ("checkpoint", "_atomic_write"),
+    ("checkpoint", "atomic_write_text"), ("checkpoint", "atomic_write_bytes"),
+}
+_IO_CALLS = {"open", "makedirs", "replace", "rename", "remove", "unlink",
+             "fsync", "copyfile", "rmtree", "move"}
+_SLEEP_CALLS = {"sleep"}
+
+
+def _exempt(node: Optional[Node]) -> bool:
+    if node is None:
+        return False
+    return (node.module, node.qual.split(":", 1)[-1]) in _POLICY_NONBLOCKING
+
+
+class _LockTable:
+    """Lock identities discovered in the scoped files.
+
+    Keys: ``module:Class.attr`` for ``self.attr = threading.Lock()``
+    assignments, ``module:name`` for module-level locks. Conditions record
+    the lock they wrap (their first constructor argument) so waits on
+    them are exempt while that lock is held.
+    """
+
+    def __init__(self) -> None:
+        self.kinds: Dict[str, str] = {}       # key -> lock|rlock|condition
+        self.cond_lock: Dict[str, str] = {}   # condition key -> lock key
+
+    def scan(self, ctx, module: str) -> None:
+        def ctor_kind(value: ast.AST) -> Optional[str]:
+            if not isinstance(value, ast.Call):
+                return None
+            return _LOCK_CTORS.get(dotted_name(value.func).rsplit(".", 1)[-1])
+
+        def register(target: ast.AST, value: ast.Call, cls: Optional[str],
+                     kind: str) -> Optional[str]:
+            key = self._key_of(target, module, cls)
+            if key is None:
+                return None
+            self.kinds[key] = kind
+            if kind == "condition" and value.args:
+                wrapped = self._key_of(value.args[0], module, cls)
+                if wrapped is not None:
+                    self.cond_lock[key] = wrapped
+            return key
+
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign):
+                kind = ctor_kind(stmt.value)
+                if kind:
+                    for tgt in stmt.targets:
+                        register(tgt, stmt.value, None, kind)
+            elif isinstance(stmt, ast.ClassDef):
+                for fn in ast.walk(stmt):
+                    if not isinstance(fn, _DEFS):
+                        continue
+                    for sub in ast.walk(fn):
+                        if isinstance(sub, ast.Assign):
+                            kind = ctor_kind(sub.value)
+                            if kind:
+                                for tgt in sub.targets:
+                                    register(tgt, sub.value, stmt.name, kind)
+
+    def _key_of(self, expr: ast.AST, module: str,
+                cls: Optional[str]) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and cls is not None:
+            return "%s:%s.%s" % (module, cls, expr.attr)
+        if isinstance(expr, ast.Name):
+            return "%s:%s" % (module, expr.id)
+        return None
+
+    def resolve(self, expr: ast.AST, node: Node) -> Optional[str]:
+        """Lock key for a use site (`with self._lock:`), or None."""
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and node.cls is not None:
+            key = "%s:%s.%s" % (node.module, node.cls, expr.attr)
+            return key if key in self.kinds else None
+        if isinstance(expr, ast.Name):
+            key = "%s:%s" % (node.module, expr.id)
+            return key if key in self.kinds else None
+        return None
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    code = "R13"
+    description = ("blocking operation (device dispatch, file I/O, "
+                   "Event.wait, sleep) under a held lock, or a cycle in "
+                   "the lock acquisition order")
+    scope_prefixes = ("serving/", "streaming/")
+    scope_exact = ("telemetry.py", "tracing.py")
+    whole_program = True
+
+    def check(self, pkg: Package) -> Iterable[Violation]:
+        graph = get_callgraph(pkg)
+        scoped_ctxs = list(self.scoped(pkg))
+        scoped = {id(c) for c in scoped_ctxs}
+
+        locks = _LockTable()
+        for ctx in scoped_ctxs:
+            mod = graph_module(ctx)
+            locks.scan(ctx, mod)
+
+        blocking = self._blocking_effects(graph)
+        acquires = self._acquire_summaries(graph, locks, scoped)
+
+        out: List[Violation] = []
+        # lock key -> {next lock key -> (ctx, line)}: acquisition order
+        order: Dict[str, Dict[str, Tuple[object, int]]] = {}
+        seen: Set[Tuple[str, int, str]] = set()
+
+        for qual in sorted(graph.nodes):
+            node = graph.nodes[qual]
+            if node.node is None or id(node.ctx) not in scoped:
+                continue
+            self._scan_regions(node, graph, locks, blocking, acquires,
+                               order, out, seen)
+
+        out.extend(self._report_cycles(order, locks))
+        return out
+
+    # -- blocking-effect fixpoint over the whole package -----------------
+    def _blocking_effects(self, graph: CallGraph) -> Dict[str, str]:
+        jit_seeds = graph.jit_seeds()
+        blocking: Dict[str, str] = {}
+
+        for qual, node in graph.nodes.items():
+            if _exempt(node):
+                continue
+            body = node.node if node.node is not None else node.ctx.tree
+            if body is None:
+                continue
+            reason = self._direct_blocking(node, graph, jit_seeds, body)
+            if reason:
+                blocking[qual] = "%s at %s:%d" % (
+                    reason[0], node.ctx.relpath, reason[1])
+
+        changed, guard = True, 0
+        while changed and guard < 200:
+            changed = False
+            guard += 1
+            for qual, node in graph.nodes.items():
+                if qual in blocking or _exempt(node):
+                    continue
+                for e in node.edges:
+                    if e.kind == "wrap" or e.target is None:
+                        continue
+                    if e.target in blocking \
+                            and not _exempt(graph.nodes.get(e.target)):
+                        blocking[qual] = "%s (via %s)" % (
+                            blocking[e.target].split(" (via ")[0], e.target)
+                        changed = True
+                        break
+        return blocking
+
+    def _direct_blocking(self, node: Node, graph: CallGraph,
+                         jit_seeds: Set[str], body: ast.AST
+                         ) -> Optional[Tuple[str, int]]:
+        for call in _own_calls(body):
+            name = dotted_name(call.func)
+            last = name.rsplit(".", 1)[-1]
+            if name == "open" or (last in _IO_CALLS
+                                  and name.split(".")[0] in ("os", "shutil")):
+                return ("file I/O (%s)" % name, call.lineno)
+            if last in _SLEEP_CALLS and name.split(".")[0] == "time":
+                return ("time.sleep", call.lineno)
+            if last == "block_until_ready":
+                return ("block_until_ready device sync", call.lineno)
+            if last in ("device_put", "device_get") \
+                    or name.split(".")[0] == "jnp" \
+                    or name.startswith("jax.numpy"):
+                return ("device op (%s)" % name, call.lineno)
+            for ref in graph.resolve_call(node, call):
+                if ref.jit_wrapped or (ref.target in jit_seeds):
+                    return ("jitted dispatch (%s)" % (name or "<call>"),
+                            call.lineno)
+        return None
+
+    # -- transitive lock acquisitions per function -----------------------
+    def _acquire_summaries(self, graph: CallGraph, locks: _LockTable,
+                           scoped: Set[int]) -> Dict[str, Set[str]]:
+        acquires: Dict[str, Set[str]] = {}
+        for qual, node in graph.nodes.items():
+            if node.node is None or id(node.ctx) not in scoped:
+                continue
+            own: Set[str] = set()
+            for stmt in ast.walk(node.node):
+                if isinstance(stmt, _DEFS) and stmt is not node.node:
+                    continue
+                if isinstance(stmt, ast.With):
+                    for item in stmt.items:
+                        key = locks.resolve(item.context_expr, node)
+                        if key:
+                            own.add(key)
+                elif isinstance(stmt, ast.Call) \
+                        and isinstance(stmt.func, ast.Attribute) \
+                        and stmt.func.attr == "acquire":
+                    key = locks.resolve(stmt.func.value, node)
+                    if key:
+                        own.add(key)
+            if own:
+                acquires[qual] = own
+
+        changed, guard = True, 0
+        while changed and guard < 200:
+            changed = False
+            guard += 1
+            for qual, node in graph.nodes.items():
+                for e in node.edges:
+                    if e.kind == "wrap" or e.target is None:
+                        continue
+                    extra = acquires.get(e.target, set()) \
+                        - acquires.get(qual, set())
+                    if extra:
+                        acquires.setdefault(qual, set()).update(extra)
+                        changed = True
+        return acquires
+
+    # -- region walk: held-lock tracking + violations --------------------
+    def _scan_regions(self, node: Node, graph: CallGraph, locks: _LockTable,
+                      blocking: Dict[str, str], acquires: Dict[str, Set[str]],
+                      order: Dict[str, Dict[str, Tuple[object, int]]],
+                      out: List[Violation],
+                      seen: Set[Tuple[str, int, str]]) -> None:
+        by_call: Dict[int, List[Edge]] = {}
+        for e in node.edges:
+            if e.call is not None:
+                by_call.setdefault(id(e.call), []).append(e)
+        jit_seeds = graph.jit_seeds()
+        # names assigned from a device dispatch in this function, for the
+        # np.asarray-on-device-array check
+        device_names: Set[str] = set()
+        for stmt in ast.walk(node.node):
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Call):
+                for e in by_call.get(id(stmt.value), ()):
+                    tgt = graph.nodes.get(e.target) if e.target else None
+                    if e.target in jit_seeds \
+                            or (tgt is not None and tgt.jitted):
+                        for t in stmt.targets:
+                            for n in ast.walk(t):
+                                if isinstance(n, ast.Name):
+                                    device_names.add(n.id)
+
+        def note_order(held: List[str], key: str, line: int) -> None:
+            for h in held:
+                if h == key:
+                    continue
+                order.setdefault(h, {}).setdefault(key, (node.ctx, line))
+
+        def check_stmt(st: ast.AST, held: List[str]) -> None:
+            """Blocking markers in one statement's own expressions."""
+            for call in _calls_in_stmt(st):
+                name = dotted_name(call.func)
+                last = name.rsplit(".", 1)[-1]
+                hit: Optional[str] = None
+                if last == "wait" and isinstance(call.func, ast.Attribute):
+                    key = locks.resolve(call.func.value, node)
+                    wrapped = locks.cond_lock.get(key or "")
+                    if key is not None and (key in held or wrapped in held):
+                        continue  # Condition.wait over the held lock
+                    hit = "%s() blocks while %s is held" % (
+                        name, held[-1])
+                elif name == "open" \
+                        or (last in _IO_CALLS
+                            and name.split(".")[0] in ("os", "shutil")):
+                    hit = "file I/O (%s) under %s" % (name, held[-1])
+                elif last in _SLEEP_CALLS and name.split(".")[0] == "time":
+                    hit = "time.sleep under %s" % held[-1]
+                elif last == "block_until_ready":
+                    hit = "block_until_ready under %s" % held[-1]
+                elif last in ("asarray", "ascontiguousarray") \
+                        and call.args \
+                        and isinstance(call.args[0], ast.Name) \
+                        and call.args[0].id in device_names:
+                    hit = ("np.%s on a device array pulls it to host "
+                           "under %s" % (last, held[-1]))
+                elif last in ("device_put", "device_get") \
+                        or name.split(".")[0] == "jnp":
+                    hit = "device op (%s) under %s" % (name, held[-1])
+                else:
+                    for e in by_call.get(id(call), ()):
+                        if e.target is None or e.kind == "wrap":
+                            continue
+                        tgt = graph.nodes.get(e.target)
+                        if _exempt(tgt):
+                            continue
+                        for lk in acquires.get(e.target, ()):
+                            note_order(held, lk, call.lineno)
+                        if e.target in jit_seeds:
+                            hit = ("jitted dispatch %s under %s"
+                                   % (name or e.target, held[-1]))
+                            break
+                        if e.target in blocking:
+                            hit = ("call to %s blocks under %s: %s"
+                                   % (name or e.target, held[-1],
+                                      blocking[e.target]))
+                            break
+                if hit:
+                    dkey = (node.ctx.relpath, call.lineno, held[-1])
+                    if dkey not in seen:
+                        seen.add(dkey)
+                        out.append(self.violation(
+                            node.ctx, call,
+                            hit + " — hoist the blocking work out of the "
+                            "lock scope (record under the lock, act after "
+                            "release) or suppress with the bound"))
+
+        def walk(stmts: Sequence[ast.AST], held: List[str]) -> None:
+            held = list(held)
+            for st in stmts:
+                if isinstance(st, _DEFS):
+                    continue
+                if isinstance(st, ast.With):
+                    inner = list(held)
+                    for item in st.items:
+                        key = locks.resolve(item.context_expr, node)
+                        if key is not None:
+                            if key in inner \
+                                    and locks.kinds.get(key) != "rlock":
+                                order.setdefault(key, {}).setdefault(
+                                    key, (node.ctx, st.lineno))
+                            note_order(inner, key, st.lineno)
+                            inner.append(key)
+                        elif held:
+                            check_stmt(item.context_expr, held)
+                    walk(st.body, inner)
+                    continue
+                if isinstance(st, ast.Expr) \
+                        and isinstance(st.value, ast.Call) \
+                        and isinstance(st.value.func, ast.Attribute):
+                    attr = st.value.func.attr
+                    key = locks.resolve(st.value.func.value, node)
+                    if key is not None and attr == "acquire":
+                        note_order(held, key, st.lineno)
+                        held.append(key)
+                        continue
+                    if key is not None and attr == "release":
+                        if key in held:
+                            held.remove(key)
+                        continue
+                if held:
+                    check_stmt(st, held)
+                for sub in (getattr(st, "body", ()),
+                            getattr(st, "orelse", ()),
+                            getattr(st, "finalbody", ())):
+                    if sub:
+                        walk(sub, held)
+                for h in getattr(st, "handlers", ()):
+                    walk(h.body, held)
+
+        walk(node.node.body, [])
+
+    # -- acquisition-order cycles ----------------------------------------
+    def _report_cycles(self, order: Dict[str, Dict[str, Tuple[object, int]]],
+                       locks: _LockTable) -> List[Violation]:
+        def reaches(src: str, dst: str) -> bool:
+            stack, visited = [src], set()
+            while stack:
+                cur = stack.pop()
+                if cur == dst:
+                    return True
+                if cur in visited:
+                    continue
+                visited.add(cur)
+                stack.extend(order.get(cur, ()))
+            return False
+
+        out: List[Violation] = []
+        for a in sorted(order):
+            for b in sorted(order[a]):
+                ctx, line = order[a][b]
+                if a == b:
+                    out.append(Violation(
+                        "lock-order-cycle", self.code, ctx.relpath, line, 0,
+                        "non-reentrant lock %s is re-acquired while "
+                        "already held: self-deadlock (use an RLock or "
+                        "split the critical section)" % a))
+                    continue
+                if reaches(b, a):
+                    out.append(Violation(
+                        "lock-order-cycle", self.code, ctx.relpath, line, 0,
+                        "acquiring %s while holding %s completes an "
+                        "acquisition-order cycle (%s is also taken while "
+                        "%s is held elsewhere): two threads interleaving "
+                        "these orders deadlock — pick one global order"
+                        % (b, a, a, b)))
+        return out
+
+
+def graph_module(ctx) -> str:
+    from ..callgraph import module_name
+
+    return module_name(ctx.relpath)
+
+
+def _calls_in_stmt(st: ast.AST):
+    """Call nodes in one statement, skipping nested defs/lambdas and the
+    bodies of nested compound statements (walked separately)."""
+    blocked: Set[int] = set()
+    for field in ("body", "orelse", "finalbody", "handlers"):
+        for sub in getattr(st, field, ()):
+            blocked.add(id(sub))
+    stack = [st]
+    while stack:
+        n = stack.pop()
+        if id(n) in blocked or isinstance(n, _DEFS) \
+                or isinstance(n, ast.Lambda):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))

@@ -1,0 +1,274 @@
+"""R14 pallas-vmem: every pallas_call's worst-case block footprint must
+fit the VMEM capacity floor from perfmodel.py.
+
+Mosaic keeps a kernel's live blocks — every in_spec and out_spec block,
+double-buffered so the next grid step's DMA overlaps compute — resident
+in VMEM. A BlockSpec that grew past the budget fails at *lowering time on
+the device*, which for this repo means during a bench run on hardware CI
+never sees. This rule evaluates the failure statically:
+
+    footprint = 2 * sum(prod(block_shape) * dtype_bytes per spec)
+
+Block dimensions resolve through the same chain R3 uses — integer
+literals, module constants, function-local ``NAME = <int>`` assignments —
+extended with keyword/positional parameter *defaults* (the static-argnum
+tile sizes) and constant folding of ``+ - * // **``. A dimension that
+stays symbolic (a runtime shape like ``Gp`` or ``F``) is replaced by its
+entry in ``perfmodel.PALLAS_DIM_BOUNDS``: the lint-time cap the call
+sites are certified against. Unknown names fall back to a conservative
+256. Element size defaults to 4 bytes (int8 planes are thus over-counted,
+never under).
+
+The budget and the bounds table live in ``<root>/perfmodel.py`` and are
+read from its AST (literal extraction + the same constant folding — the
+linter stays stdlib-only, nothing is imported). Packages without a
+perfmodel (the test fixtures) get the built-in 16 MiB floor and the
+built-in bounds. Because the tables are rule *configuration*, the cache
+key includes the perfmodel digest (cache.py) — editing a bound reruns the
+rule everywhere.
+
+Suppression policy: a kernel that genuinely needs more than the floor on
+a bigger device must carry a reasoned suppression naming the device kind
+it is restricted to — there is no blanket opt-out.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..core import FileContext, Package, Violation, dotted_name, keyword_arg
+from .base import Rule
+from .pallas_rules import _module_int_constants
+
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_DEFAULT_BUDGET = 16 * 1024 * 1024
+_DEFAULT_BOUND = 256
+_BUILTIN_BOUNDS = {"num_bins": 256, "n_bins": 256, "tile_rows": 2048}
+_DTYPE_BYTES = {"float64": 8, "int64": 8, "uint64": 8,
+                "float32": 4, "int32": 4, "uint32": 4,
+                "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+                "int8": 1, "uint8": 1, "bool_": 1, "bool": 1}
+
+
+def _fold_int(node: ast.AST, resolve) -> Optional[int]:
+    """Constant-fold an int expression; `resolve(name)` supplies values
+    for bare names (module consts, locals, bounds)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _fold_int(node.operand, resolve)
+        return -v if v is not None else None
+    if isinstance(node, ast.BinOp):
+        left = _fold_int(node.left, resolve)
+        right = _fold_int(node.right, resolve)
+        if left is None or right is None:
+            return None
+        if isinstance(node.op, ast.Add):
+            return left + right
+        if isinstance(node.op, ast.Sub):
+            return left - right
+        if isinstance(node.op, ast.Mult):
+            return left * right
+        if isinstance(node.op, ast.FloorDiv) and right != 0:
+            return left // right
+        if isinstance(node.op, ast.Pow) and 0 <= right <= 64:
+            return left ** right
+        return None
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return resolve(node)
+    return None
+
+
+def _perfmodel_tables(pkg: Package) -> Tuple[int, Dict[str, int]]:
+    """(budget floor, dim bounds) extracted from <root>/perfmodel.py's
+    AST, or the built-in defaults when the package has none."""
+    budget, bounds = _DEFAULT_BUDGET, dict(_BUILTIN_BOUNDS)
+    path = pkg.root / "perfmodel.py"
+    try:
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+    except (OSError, SyntaxError):
+        return budget, bounds
+    consts = _module_int_constants(tree)
+
+    def resolve(node: ast.AST) -> Optional[int]:
+        if isinstance(node, ast.Name):
+            return consts.get(node.id)
+        return None
+
+    for stmt in tree.body:
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets: Sequence[ast.AST] = [stmt.target]
+            value = stmt.value
+        elif isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        else:
+            continue
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if "PALLAS_VMEM_DEFAULT_BYTES" in names:
+            v = _fold_int(value, resolve)
+            if v is not None:
+                budget = v
+        if "PALLAS_DIM_BOUNDS" in names and isinstance(value, ast.Tuple):
+            for elt in value.elts:
+                if isinstance(elt, ast.Tuple) and len(elt.elts) == 2 \
+                        and isinstance(elt.elts[0], ast.Constant) \
+                        and isinstance(elt.elts[0].value, str):
+                    bound = _fold_int(elt.elts[1], resolve)
+                    if bound is not None:
+                        bounds[elt.elts[0].value] = bound
+    return budget, bounds
+
+
+def _enclosing_function(tree: ast.Module, call: ast.Call
+                        ) -> Optional[ast.AST]:
+    """Innermost def containing `call` (by position)."""
+    best: Optional[ast.AST] = None
+    for node in ast.walk(tree):
+        if isinstance(node, _DEFS):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= call.lineno <= end:
+                if best is None or node.lineno > best.lineno:
+                    best = node
+    return best
+
+
+def _local_env(fn: Optional[ast.AST], consts: Dict[str, int]
+               ) -> Tuple[Dict[str, int], Dict[str, ast.AST]]:
+    """(resolvable ints, name -> assigned expr) inside one function:
+    local int assignments plus parameter defaults."""
+    ints: Dict[str, int] = {}
+    assigns: Dict[str, ast.AST] = {}
+    if fn is None:
+        return ints, assigns
+
+    def resolve(node: ast.AST) -> Optional[int]:
+        if isinstance(node, ast.Name):
+            return ints.get(node.id, consts.get(node.id))
+        return None
+
+    args = fn.args
+    pos = list(args.posonlyargs) + list(args.args)
+    for arg, default in zip(pos[len(pos) - len(args.defaults):],
+                            args.defaults):
+        v = _fold_int(default, resolve)
+        if v is not None:
+            ints[arg.arg] = v
+    for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+        if default is not None:
+            v = _fold_int(default, resolve)
+            if v is not None:
+                ints[arg.arg] = v
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            assigns[stmt.targets[0].id] = stmt.value
+            v = _fold_int(stmt.value, resolve)
+            if v is not None:
+                ints[stmt.targets[0].id] = v
+    return ints, assigns
+
+
+def _block_shape(spec_call: ast.Call) -> Optional[ast.Tuple]:
+    shape = keyword_arg(spec_call, "block_shape")
+    if shape is None and spec_call.args:
+        shape = spec_call.args[0]
+    return shape if isinstance(shape, ast.Tuple) else None
+
+
+class PallasVmemRule(Rule):
+    name = "pallas-vmem"
+    code = "R14"
+    description = ("worst-case pallas_call block footprint (double-"
+                   "buffered) exceeds the VMEM budget floor from "
+                   "perfmodel.py")
+
+    def check(self, pkg: Package) -> Iterable[Violation]:
+        budget, bounds = _perfmodel_tables(pkg)
+        out: List[Violation] = []
+        for ctx in self.scoped(pkg):
+            if "pallas_call" not in ctx.source:
+                continue
+            out.extend(self._check_file(ctx, budget, bounds))
+        return out
+
+    def _check_file(self, ctx: FileContext, budget: int,
+                    bounds: Dict[str, int]) -> List[Violation]:
+        consts = _module_int_constants(ctx.tree)
+        out: List[Violation] = []
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if dotted_name(call.func).rsplit(".", 1)[-1] != "pallas_call":
+                continue
+            fn = _enclosing_function(ctx.tree, call)
+            local_ints, local_assigns = _local_env(fn, consts)
+
+            def resolve_name(node: ast.AST) -> Optional[int]:
+                if isinstance(node, ast.Name):
+                    v = local_ints.get(node.id, consts.get(node.id))
+                    if v is not None:
+                        return v
+                    return bounds.get(node.id, _DEFAULT_BOUND)
+                if isinstance(node, ast.Attribute):
+                    return bounds.get(node.attr, _DEFAULT_BOUND)
+                return None
+
+            specs = self._spec_calls(call, local_assigns)
+            total = 0
+            parts: List[str] = []
+            for spec in specs:
+                shape = _block_shape(spec)
+                if shape is None:
+                    continue
+                elems = 1
+                dims: List[str] = []
+                for d in shape.elts:
+                    v = _fold_int(d, resolve_name)
+                    if v is None:
+                        v = _DEFAULT_BOUND
+                    elems *= max(int(v), 1)
+                    dims.append(str(v))
+                total += elems * 4
+                parts.append("(%s)" % ", ".join(dims))
+            if not parts:
+                continue
+            worst = 2 * total  # Mosaic double-buffers the block pipeline
+            if worst > budget:
+                out.append(self.violation(
+                    ctx, call,
+                    "worst-case VMEM footprint %.1f MiB (2x double-"
+                    "buffered blocks %s at 4 B/elem, runtime dims bounded "
+                    "by perfmodel.PALLAS_DIM_BOUNDS) exceeds the %.1f MiB "
+                    "device floor (perfmodel.PALLAS_VMEM_DEFAULT_BYTES) — "
+                    "shrink the tile, split the grid, or restrict the "
+                    "kernel to a larger device with a reasoned "
+                    "suppression"
+                    % (worst / 1048576.0, " + ".join(parts),
+                       budget / 1048576.0)))
+        return out
+
+    def _spec_calls(self, call: ast.Call,
+                    local_assigns: Dict[str, ast.AST]) -> List[ast.Call]:
+        """Every BlockSpec construction feeding this pallas_call: through
+        in_specs/out_specs/grid_spec keywords, following one level of
+        function-local ``name = <expr>`` indirection."""
+        roots: List[ast.AST] = []
+        for kw in ("grid_spec", "in_specs", "out_specs"):
+            value = keyword_arg(call, kw)
+            if isinstance(value, ast.Name):
+                value = local_assigns.get(value.id)
+            if value is not None:
+                roots.append(value)
+        if not roots:
+            roots = [call]
+        specs: List[ast.Call] = []
+        seen = set()
+        for root in roots:
+            for sub in ast.walk(root):
+                if isinstance(sub, ast.Call) and id(sub) not in seen \
+                        and dotted_name(sub.func).rsplit(".", 1)[-1] \
+                        == "BlockSpec":
+                    seen.add(id(sub))
+                    specs.append(sub)
+        return specs
