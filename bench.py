@@ -247,6 +247,102 @@ def _bench_gang_recovery() -> dict:
         return {"gang_error": repr(e)[:200]}
 
 
+def _bench_voting_fields() -> dict:
+    """Pod-scale learner comm capture (docs/PERF_NOTES.md round-9): grow
+    trees over the same wide dataset (F=256 — the regime the PV-Tree
+    voting scheme is priced for) with the data-parallel, voting-parallel
+    and feature-parallel device learners plus the single-device baseline,
+    and record
+
+    * the per-wave ICI gauges each learner publishes — the three-way comm
+      model: full-histogram psum_scatter (data) vs nomination gather +
+      elected-slice psum (voting) vs best-record all_gather (feature);
+    * device_ici_overlap_pct — the share of the elected-slice reduction
+      the double-buffered dispatch hides behind partition/commit;
+    * voting_miss_total under LGBM_TPU_VOTING_EXACT_CHECK=1: elections
+      where the full reduction disagreed with the committed split (0 on a
+      single shard, where the local argmax is always nominated);
+    * scaling_efficiency_{data,voting,feature}: measured rows/s against
+      D x the single-device learner's.
+
+    Smoke-asserted on the spot: voting must move strictly fewer bytes per
+    wave than data-parallel, and feature-parallel fewer than voting — the
+    ordering the round-9 model predicts at F=256, top_k=20.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Dataset as CoreDataset
+    from lightgbm_tpu.parallel.learners import (
+        DeviceDataParallelTreeLearner, DeviceFeatureParallelTreeLearner,
+        VotingDataParallelTreeLearner)
+    from lightgbm_tpu.treelearner.device import DeviceTreeLearner
+    from lightgbm_tpu.utils.timer import global_timer
+
+    n, f = 4096, 256
+    rng = np.random.default_rng(11)
+    X = rng.standard_normal((n, f)).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] + 0.25 * X[:, 2] > 0).astype(np.float32)
+    g = (0.5 - y + 0.1 * rng.standard_normal(n)).astype(np.float32)
+    gh = np.stack([g, np.full(n, 0.25, np.float32),
+                   np.ones(n, np.float32)], axis=1)
+    gh_ext = jnp.asarray(
+        np.concatenate([gh, np.zeros((1, 3), np.float32)]))
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 64,
+              "min_data_in_leaf": 20, "top_k": 20, "verbosity": -1}
+
+    def _train(cls):
+        cfg = Config(params)
+        ds = CoreDataset.from_matrix(X, label=y, config=cfg)
+        learner = cls(cfg, ds)
+        learner.finalize(learner.train_async(gh_ext))  # compile warmup
+        t0 = time.perf_counter()
+        learner.finalize(learner.train_async(gh_ext))
+        return learner, time.perf_counter() - t0
+
+    _, single_s = _train(DeviceTreeLearner)
+    _GAUGES = ("device_ici_bytes_per_wave", "voting_ici_bytes_per_wave",
+               "feature_ici_bytes_per_wave", "device_ici_overlap_pct",
+               "voting_miss_total")
+    out, ici = {}, {}
+    for key, cls in (("data", DeviceDataParallelTreeLearner),
+                     ("voting", VotingDataParallelTreeLearner),
+                     ("feature", DeviceFeatureParallelTreeLearner)):
+        for c in _GAUGES:
+            global_timer.counters.pop(c, None)
+        saved = os.environ.get("LGBM_TPU_VOTING_EXACT_CHECK")
+        if key == "voting":
+            os.environ["LGBM_TPU_VOTING_EXACT_CHECK"] = "1"
+        try:
+            learner, el = _train(cls)
+        finally:
+            if key == "voting":
+                if saved is None:
+                    os.environ.pop("LGBM_TPU_VOTING_EXACT_CHECK", None)
+                else:
+                    os.environ["LGBM_TPU_VOTING_EXACT_CHECK"] = saved
+        ici[key] = int(global_timer.counters["device_ici_bytes_per_wave"])
+        out[f"scaling_efficiency_{key}"] = round(
+            single_s / (learner.D * el), 4) if el > 0 else 0.0
+        if key == "voting":
+            out["voting_ici_bytes_per_wave"] = int(
+                global_timer.counters["voting_ici_bytes_per_wave"])
+            out["device_ici_overlap_pct"] = int(
+                global_timer.counters["device_ici_overlap_pct"])
+            out["voting_miss_total"] = int(
+                global_timer.counters.get("voting_miss_total", 0))
+        elif key == "feature":
+            out["feature_ici_bytes_per_wave"] = int(
+                global_timer.counters["feature_ici_bytes_per_wave"])
+    assert out["voting_ici_bytes_per_wave"] < ici["data"], (
+        "voting moved more ICI bytes than the full reduction", out, ici)
+    assert out["feature_ici_bytes_per_wave"] < out[
+        "voting_ici_bytes_per_wave"], (
+        "feature-parallel should be the cheapest wire", out)
+    return out
+
+
 def run_bench(n_rows: int) -> dict:
     import lightgbm_tpu as lgb
     from lightgbm_tpu import telemetry
@@ -575,6 +671,15 @@ def run_bench(n_rows: int) -> dict:
                 (time.perf_counter() - t0) * 1000.0, 3)
         except Exception as e:  # noqa: BLE001 - secondary must not kill primary
             out["stream_error"] = repr(e)[:200]
+
+    # pod-scale learner comm capture (docs/PERF_NOTES.md round-9): the
+    # three-way ICI model (data vs voting vs feature) on a fixed wide
+    # dataset — cost is independent of n_rows, so it always runs
+    if os.environ.get("BENCH_VOTING", "1") not in ("0", "false"):
+        try:
+            out.update(_bench_voting_fields())
+        except Exception as e:  # noqa: BLE001 - secondary must not kill primary
+            out["voting_error"] = repr(e)[:200]
     return out
 
 
@@ -656,6 +761,11 @@ def main() -> None:
                       "wave_commit_rate", "adaptive_k_final",
                       "scan_kernel_ms", "goss_device_gather_ms",
                       "scan_kernel_error", "goss_kernel_error",
+                      "voting_ici_bytes_per_wave",
+                      "feature_ici_bytes_per_wave",
+                      "device_ici_overlap_pct", "voting_miss_total",
+                      "scaling_efficiency_data", "scaling_efficiency_voting",
+                      "scaling_efficiency_feature", "voting_error",
                       "attribution"):
                 if k in res:
                     record[k] = res[k]

@@ -44,6 +44,8 @@ from ..ops.quantize import int16_reduction_safe
 from ..ops.split import (SplitInfo, gather_feature_hist, pad_feature_meta,
                          per_feature_best, per_feature_best_categorical,
                          reduce_best_record, scan_meta_of)
+from ..perfmodel import (feature_ici_bytes_per_wave, ici_overlap_pct,
+                         voting_ici_bytes_per_wave)
 from ..treelearner.device import (REC, DeviceTreeLearner, _PendingTree,
                                   make_sharded_grow_fn)
 from ..treelearner.serial import (SerialTreeLearner, _LeafState,
@@ -66,6 +68,21 @@ def _better_record(recs: jax.Array, other: jax.Array) -> jax.Array:
     """Row-wise pick the higher-gain record. Each feature is either numerical
     or categorical, so exactly one of the two scans can be finite per row."""
     return jnp.where((other[:, 0] > recs[:, 0])[:, None], other, recs)
+
+
+def _make_inbag_count_fn(mesh):
+    """jit(shard_map) GLOBAL in-bag row count: psum of each shard's local
+    `leaf_id == 0` count. Every dtype decision on the reduction wire (the
+    int16 histogram packing) must key off this global count — under skewed
+    bagging two shards' LOCAL counts can fall on opposite sides of the
+    int16 bound, and shards disagreeing on the wire dtype deadlock or
+    garble the psum."""
+
+    def body(leaf_sh):
+        return jax.lax.psum((leaf_sh == 0).sum().astype(jnp.int32), "data")
+
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(P("data"),),
+                             out_specs=P(), check_vma=False))
 
 
 def _make_feature_scan_fn(mesh, f_local, has_cat: bool = False):
@@ -139,6 +156,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
         self._row_valid = np.zeros(self.n_pad, dtype=bool)
         self._row_valid[: self.num_data] = True
         self.leaf_id: Optional[jax.Array] = None
+        self._inbag_count_fn = _make_inbag_count_fn(self.mesh)
         self._build_step_fns()
 
     # -------------------------------------------------------- device layout
@@ -241,7 +259,12 @@ class DataParallelTreeLearner(SerialTreeLearner):
         ids = np.where(in_bag, 0, -1).astype(np.int32)
         self.leaf_id = put_global(ids, self.mesh, P("data"))
         self.partition = LeafIdPartition(self)
-        self.partition.counts[0] = int(in_bag.sum())
+        # root count from the DEVICE psum, not the host-side in_bag.sum():
+        # _int16_reduction_safe keys the reduction dtype off counts[0], and
+        # a local/per-process bag view here would let shards pick different
+        # wire dtypes under skewed bagging (see _make_inbag_count_fn)
+        self.partition.counts[0] = int(host_value(
+            self._inbag_count_fn(self.leaf_id)))
         # tree-level column sampling (per-node masks would need a transfer
         # per leaf; the distributed learners sample per tree only)
         F = len(self.meta.real_feature)
@@ -460,6 +483,11 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
     configs the device grower cannot serve (categorical, per-node masks,
     monotone, CEGB, linear trees — device_growth_applies)."""
 
+    # the feature-parallel subclass replicates the rows (and skips the
+    # per-shard row padding — the grower pads internally, single-device
+    # style); everything else about the dispatch shell is shared
+    _replicate_rows = False
+
     def __init__(self, config: Config, dataset: Dataset) -> None:
         from ..ops.compact_pallas import COMPACT_TILE
         from ..ops.hist_pallas import DEFAULT_TILE_ROWS
@@ -469,8 +497,13 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         # every shard must be a multiple of the wave tile unit so the
         # shard_map body needs no per-device re-padding
         self._row_unit = max(DEFAULT_TILE_ROWS, COMPACT_TILE)
-        self.n_pad = padded_row_count(dataset.num_data, self.D,
-                                      self._row_unit)
+        if self._replicate_rows:
+            self.n_pad = dataset.num_data
+            self._row_spec = P()
+        else:
+            self.n_pad = padded_row_count(dataset.num_data, self.D,
+                                          self._row_unit)
+            self._row_spec = P("data")
         super().__init__(config, dataset)
         F = len(self.meta.real_feature)
         self.f_pad = _ceil_to(max(F, self.D), self.D)
@@ -487,6 +520,42 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         self._tables_rep = put_replicated(self.tables, self.mesh)
         self._params_rep = put_replicated(self.params_dev, self.mesh)
         self._grow_fns = {}
+        self._inbag_count_fn = (None if self._replicate_rows
+                                else _make_inbag_count_fn(self.mesh))
+        self._scan_args()
+
+    # --------------------------------------------------- per-mode hooks
+    # (overridden by the voting / feature-parallel subclasses below)
+
+    def _scan_args(self) -> None:
+        """Placement of the scan tables + the feature-mask spec for this
+        mode: data-parallel scans feature-SHARDED blocks after the
+        psum_scatter, so scan_meta/mask shard and the raw gather tables
+        replicate."""
+        self._scan_meta_arg = self.scan_meta_sharded
+        self._gidx_arg = self._gidx_rep
+        self._vslot_arg = self._vslot_rep
+        self._fmask_spec = P("data")
+
+    def _grow_fn_extra(self) -> dict:
+        return {}
+
+    def _extra_grow_args(self) -> tuple:
+        return ()
+
+    def _note_grow_extras(self, extra: tuple) -> None:
+        pass
+
+    def _narrow(self, leaf_sh: jax.Array) -> bool:
+        """int16 wire packing decision from the GLOBAL psum'd in-bag count
+        (satellite bugfix: a local/per-process bag view can fall on
+        opposite sides of the int16 bound under skewed bagging, and shards
+        disagreeing on the reduction dtype deadlock or garble the wire).
+        The scalar pull only syncs on the quantized path."""
+        if not self.quantized:
+            return False
+        n_g = int(host_value(self._inbag_count_fn(leaf_sh)))
+        return int16_reduction_safe(n_g, self.config.num_grad_quant_bins)
 
     def snapshot_state(self) -> dict:
         st = super().snapshot_state()
@@ -505,13 +574,15 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
     def _device_bins(self, dataset: Dataset) -> jax.Array:
         """Rows padded to the sharded tile unit and split on `data` (each
         device holds its contiguous row block); same native-width rules as
-        the single-device learner."""
+        the single-device learner. The feature-parallel subclass places
+        them replicated instead (n_pad == num_data, so the pad is empty)."""
         bins_pad = np.pad(dataset.bins,
                           ((0, 0), (0, self.n_pad - dataset.num_data)))
         if (bins_pad.dtype.itemsize == 1
                 and os.environ.get("LGBM_TPU_BINS_I32", "") == "1"):
             bins_pad = bins_pad.astype(np.int32)
-        return put_global(bins_pad, self.mesh, P(None, "data"))
+        spec = P() if self._replicate_rows else P(None, "data")
+        return put_global(bins_pad, self.mesh, spec)
 
     def _grow_fn(self, bagged: bool, narrow: bool):
         key = (bagged, narrow)
@@ -520,7 +591,8 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
                 self.mesh, num_leaves=self.config.num_leaves,
                 num_bins=self.group_bin_padded,
                 max_depth=self.config.max_depth, quantized=self.quantized,
-                batch=self.wave, bagged=bagged, narrow=narrow)
+                batch=self.wave, bagged=bagged, narrow=narrow,
+                **self._grow_fn_extra())
         return self._grow_fns[key]
 
     def _record_ici_bytes(self, narrow: bool) -> None:
@@ -559,30 +631,32 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         ids_pad[:n] = ids
         gh_pad = jnp.concatenate(
             [gh, jnp.zeros((npad - n, gh.shape[1]), gh.dtype)])
-        gh_sh = put_global(gh_pad, self.mesh, P("data"))
-        leaf_sh = put_global(ids_pad, self.mesh, P("data"))
+        gh_sh = put_global(gh_pad, self.mesh, self._row_spec)
+        leaf_sh = put_global(ids_pad, self.mesh, self._row_spec)
 
         F = len(self.meta.real_feature)
         mask = np.ones(self.f_pad, dtype=bool)
         if self.col_sampler.active:
             mask[:F] = self.col_sampler.reset_by_tree()
-        fmask_sh = put_global(mask, self.mesh, P("data"))
+        fmask_sh = put_global(mask, self.mesh, self._fmask_spec)
         scale = (self._scale_vec if self.quantized
                  else jnp.ones(3, jnp.float32))
         scale_rep = put_global(scale, self.mesh, P())
 
-        narrow = self.quantized and int16_reduction_safe(
-            n_bag, cfg.num_grad_quant_bins)
+        narrow = self._narrow(leaf_sh)
         self._record_carry_bytes()
         self._record_ici_bytes(narrow)
         grow = sanitize.guard(
             self._grow_fn(bag_indices is not None, narrow), (0, 1, 2),
             "the sharded grow dispatch (parallel/learners.py train_async)")
         with global_timer.scope("tree_device"):
-            rec_store, leaf_id, _, hist_rows, n_waves = grow(
-                jnp.copy(self.bins_dev), gh_sh, leaf_sh, self._gidx_rep,
-                self._vslot_rep, self.scan_meta_sharded, self._tables_rep,
-                self._params_rep, fmask_sh, scale_rep)
+            out = grow(
+                jnp.copy(self.bins_dev), gh_sh, leaf_sh, self._gidx_arg,
+                self._vslot_arg, self._scan_meta_arg, self._tables_rep,
+                self._params_rep, fmask_sh, scale_rep,
+                *self._extra_grow_args())
+        rec_store, leaf_id, _, hist_rows, n_waves = out[:5]
+        self._note_grow_extras(out[5:])
         leaf_id = leaf_id[:n]
         for arr in (rec_store, leaf_id, hist_rows, n_waves):
             start = getattr(arr, "copy_to_host_async", None)
@@ -600,6 +674,118 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
             tree, jnp.asarray(np.asarray(leaf_id)))
 
 
+class VotingDataParallelTreeLearner(DeviceDataParallelTreeLearner):
+    """tree_learner=voting + device growth: the whole-tree wave learner
+    with PV-Tree two-phase voting (voting_parallel_tree_learner.cpp) on
+    the reduction. Rows shard like the data-parallel learner, but every
+    device keeps the full LOCAL group-histogram pool and scans ALL
+    features locally; a [2K, D*top_k] nomination all_gather elects <=
+    2*top_k global candidates per child, and ONLY the elected [Bmax, CH]
+    slices are psum'd before a replicated rescan commits the split — per-
+    wave ICI volume is O(K * top_k * Bmax), independent of F
+    (perfmodel.voting_ici_bytes_per_wave). With top_k >= F every feature
+    is elected and the trees are bit-identical to the data-parallel
+    learner. LGBM_TPU_VOTING_EXACT_CHECK=1 also runs the full reduction
+    and counts committed-split disagreements (voting_miss_total)."""
+
+    def __init__(self, config: Config, dataset: Dataset) -> None:
+        super().__init__(config, dataset)
+        self._exact_check = os.environ.get(
+            "LGBM_TPU_VOTING_EXACT_CHECK", "").lower() in ("1", "true",
+                                                           "on")
+        self._k_local = max(1, min(int(config.top_k), self.f_pad))
+        self._k_global = max(1, min(2 * int(config.top_k), self.f_pad))
+        self._pending_miss = []
+
+    def _scan_args(self) -> None:
+        # the local scan covers the FULL padded feature axis on every
+        # device: scan meta, gather tables and mask all ride replicated
+        self._scan_meta_arg = put_replicated(scan_meta_of(self.meta_pad),
+                                             self.mesh)
+        self._gidx_arg = self._gidx_rep
+        self._vslot_arg = self._vslot_rep
+        self._fmask_spec = P()
+
+    def _grow_fn_extra(self) -> dict:
+        return {"mode": "voting", "top_k": int(self.config.top_k),
+                "exact_check": self._exact_check}
+
+    def _extra_grow_args(self) -> tuple:
+        from ..utils import faults
+        skew = faults.vote_skew_params()
+        r, w = skew if skew is not None else (-1, -1)
+        return (put_replicated(jnp.int32(r), self.mesh),
+                put_replicated(jnp.int32(w), self.mesh))
+
+    def _note_grow_extras(self, extra: tuple) -> None:
+        self._pending_miss.append(extra[0])
+
+    def _record_ici_bytes(self, narrow: bool) -> None:
+        """Gauge: the nomination all_gather + the ELECTED slice psum only
+        — no term scales with F (tests assert F-independence at two
+        widths). The smaller-child half of each wave is dispatched before
+        the larger-child subtraction it overlaps, so half the wave's ICI
+        bytes hide behind local compute by construction."""
+        K = max(1, min(self.wave, self.config.num_leaves))
+        pool_bytes = 2 if narrow else 4
+        bytes_w = voting_ici_bytes_per_wave(
+            K, self._k_local, self._k_global, self.meta.max_bins, self.D,
+            pool_bytes=pool_bytes)
+        global_timer.set_count("device_ici_bytes_per_wave", bytes_w)
+        global_timer.set_count("voting_ici_bytes_per_wave", bytes_w)
+        global_timer.set_count(
+            "device_ici_overlap_pct",
+            int(ici_overlap_pct(bytes_w // 2, bytes_w)))
+
+    def finalize(self, pending: _PendingTree) -> Tree:
+        tree = super().finalize(pending)
+        if self._pending_miss:
+            from ..utils import faults
+            miss = int(host_value(self._pending_miss.pop(0)))
+            global_timer.add_count("voting_miss_total", miss)
+            faults.check_vote_skew_surfaced(miss, self._exact_check)
+        return tree
+
+
+class DeviceFeatureParallelTreeLearner(DeviceDataParallelTreeLearner):
+    """tree_learner=feature + device growth: rows REPLICATED, each device
+    owns a disjoint block of the padded feature axis and scans only it;
+    the single collective is the [2K, D, REC] best-record all_gather
+    (feature_parallel_tree_learner.cpp semantics — comm independent of
+    both N and F, the right regime for wide-sparse data). The lowest
+    device owns the lowest feature range and reduce_best_record breaks
+    ties toward the first record, so the gathered argmax equals the
+    serial learner's full-scan argmax."""
+
+    _replicate_rows = True
+
+    def _scan_args(self) -> None:
+        # rows replicate; the gather tables + scan meta + mask shard on
+        # the feature axis instead
+        self._scan_meta_arg = self.scan_meta_sharded
+        self._gidx_arg = put_global(self.meta_pad.gather_index, self.mesh,
+                                    P("data"))
+        self._vslot_arg = put_global(self.meta_pad.valid_slot, self.mesh,
+                                     P("data"))
+        self._fmask_spec = P("data")
+
+    def _grow_fn_extra(self) -> dict:
+        return {"mode": "feature"}
+
+    def _narrow(self, leaf_sh: jax.Array) -> bool:
+        # nothing histogram-shaped crosses the wire — no packing decision
+        return False
+
+    def _record_ici_bytes(self, narrow: bool) -> None:
+        """Gauge: the best-record all_gather is the ONLY collective —
+        O(2K*D*REC), independent of N and F (tests assert the
+        N-independence)."""
+        K = max(1, min(self.wave, self.config.num_leaves))
+        bytes_w = feature_ici_bytes_per_wave(K, self.D)
+        global_timer.set_count("device_ici_bytes_per_wave", bytes_w)
+        global_timer.set_count("feature_ici_bytes_per_wave", bytes_w)
+
+
 def create_parallel_learner(learner_type: str, config: Config,
                             dataset: Dataset):
     from ..treelearner.cegb import CEGB
@@ -614,19 +800,28 @@ def create_parallel_learner(learner_type: str, config: Config,
     if CEGB.enabled(config):
         Log.fatal("cegb_* parameters are not supported with distributed "
                   "tree learners (use tree_learner=serial)")
-    if config.use_quantized_grad and learner_type == "voting":
-        Log.fatal("use_quantized_grad is not supported with "
-                  "tree_learner=voting (use data or feature)")
+    # device growth shards the whole-tree wave learner over the mesh (one
+    # dispatch per tree); host-driven leaf-wise growth stays the fallback
+    # for configs the device grower cannot serve
+    on_device = device_growth_applies(getattr(config, "device_type", "cpu"),
+                                      config, dataset)
+    if (config.use_quantized_grad and learner_type == "voting"
+            and not on_device):
+        # the DEVICE voting learner reduces raw integer slices exactly
+        # like the data-parallel path; only the host-driven PV-Tree
+        # fallback keeps the restriction
+        Log.fatal("use_quantized_grad is not supported with the host "
+                  "tree_learner=voting fallback (use data or feature)")
     if learner_type == "data":
-        # device growth shards the whole-tree wave learner over the mesh
-        # (one dispatch per tree); host-driven leaf-wise growth stays the
-        # fallback for configs the device grower cannot serve
-        if device_growth_applies(getattr(config, "device_type", "cpu"),
-                                 config, dataset):
+        if on_device:
             return DeviceDataParallelTreeLearner(config, dataset)
         return DataParallelTreeLearner(config, dataset)
     if learner_type == "feature":
+        if on_device:
+            return DeviceFeatureParallelTreeLearner(config, dataset)
         return FeatureParallelTreeLearner(config, dataset)
     if learner_type == "voting":
+        if on_device:
+            return VotingDataParallelTreeLearner(config, dataset)
         return VotingParallelTreeLearner(config, dataset)
     Log.fatal("Unknown parallel tree learner type: %s", learner_type)

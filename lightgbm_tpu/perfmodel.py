@@ -112,6 +112,40 @@ def ici_bytes_per_wave(wave_width: int, f_pad: int, max_bins: int,
             + 2 * k * int(f_pad) * REC_FIELDS * 4)
 
 
+def voting_ici_bytes_per_wave(wave_width: int, k_local: int, k_global: int,
+                              max_bins: int, n_shards: int, ch: int = 3,
+                              pool_bytes: int = 4) -> int:
+    """Cross-device bytes per wave for the voting-parallel learner
+    (PERF_NOTES round-9, PV-Tree): the [2K, D*k_local] nomination
+    all_gather plus the psum of the [2K, k_global, Bmax, CH] ELECTED
+    histogram slices. No term scales with the feature count — that is the
+    whole point of the vote."""
+    k = int(wave_width)
+    return (2 * k * int(n_shards) * int(k_local) * 4
+            + 2 * k * int(k_global) * int(max_bins) * int(ch)
+            * int(pool_bytes))
+
+
+def feature_ici_bytes_per_wave(wave_width: int, n_shards: int) -> int:
+    """Cross-device bytes per wave for the feature-parallel learner
+    (PERF_NOTES round-9): rows are replicated and every histogram stays
+    local, so the only traffic is the [2K, D, REC] best-record all_gather
+    — independent of the row count AND the feature count."""
+    return 2 * int(wave_width) * int(n_shards) * REC_FIELDS * 4
+
+
+def ici_overlap_pct(overlapped_bytes: int, total_bytes: int) -> float:
+    """Share of a wave's ICI traffic dispatched while independent local
+    compute is still pending (double-buffered dispatch, PERF_NOTES
+    round-9) — the fraction of the transfer XLA's async collectives can
+    hide behind the Pallas kernels. Byte accounting, so the gauge is
+    deterministic; the wall-clock benefit shows up in the tree_device
+    stage attribution instead."""
+    if int(total_bytes) <= 0:
+        return 0.0
+    return round(100.0 * int(overlapped_bytes) / int(total_bytes), 2)
+
+
 # Peak HBM bandwidth per chip by device kind (bytes/s). Matched by
 # substring against jax's `device_kind` string; used for the roofline
 # fraction in attribution reports. Override with LGBM_TPU_PEAK_BW_GBPS.

@@ -130,15 +130,22 @@ def _decide_go_left(gb, thresh, default_left, missing_type, default_bin,
 
 
 class ShardMeta(NamedTuple):
-    """Split-scan metadata for the ICI-sharded grower. The raw gather
-    tables span the FULL padded feature axis (every device gathers all
-    features from its local group histogram before the cross-device
-    psum_scatter hands it a feature block); `scan` holds only this
-    device's feature block."""
+    """Split-scan metadata for the ICI-sharded growers. Layout depends on
+    the comm mode (see make_sharded_grow_fn):
 
-    gather_index: jax.Array  # [F_pad, Bmax] int32, replicated
-    valid_slot: jax.Array  # [F_pad, Bmax] bool, replicated
-    scan: ScanMeta  # this device's [f_local] feature block
+    * mode="data" — gather tables span the FULL padded feature axis
+      replicated (every device gathers all features from its local group
+      histogram before the psum_scatter hands it a feature block); `scan`
+      holds only this device's feature block.
+    * mode="voting" — everything spans the FULL padded feature axis
+      replicated: local scans nominate over all features and only elected
+      slices are reduced.
+    * mode="feature" — everything holds only this device's feature block
+      (tables arrive feature-sharded; rows are replicated)."""
+
+    gather_index: jax.Array  # [F_pad | f_local, Bmax] int32
+    valid_slot: jax.Array  # [F_pad | f_local, Bmax] bool
+    scan: ScanMeta  # matching [F_pad | f_local] feature block
 
 
 # graftlint: disable=untimed-hot-func -- traced only inside the jitted grow_tree_on_device / make_sharded_grow_fn wrappers; every call site runs under the timed tree_device scope
@@ -147,17 +154,21 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
                feature_mask: jax.Array, scale_vec: Optional[jax.Array], *,
                num_leaves: int, num_bins: int, max_depth: int,
                quantized: bool, batch: int, bagged: bool,
-               sharded: bool, narrow: bool):
+               sharded: bool, narrow: bool, mode: str = "data",
+               top_k: int = 0, exact_check: bool = False,
+               skew: Optional[Tuple[jax.Array, jax.Array]] = None):
     """Shared wave-loop body of the single-device and ICI-sharded growers.
 
     sharded=False: `meta` is a FeatureMeta and everything is local — the
     body of the public `grow_tree_on_device`.
 
     sharded=True runs inside a `jax.shard_map` over the "data" mesh axis
-    (see make_sharded_grow_fn): bins/gh/leaf_id0 are this device's
-    leaf-contiguous row shard, `meta` is a ShardMeta, and per wave the
-    ONLY cross-device traffic — all of it O(K*F*Bmax*CH), independent of
-    the row count — is
+    (see make_sharded_grow_fn); `meta` is a ShardMeta and `mode` picks the
+    comm scheme:
+
+    mode="data" — bins/gh/leaf_id0 are this device's leaf-contiguous row
+    shard, and per wave the ONLY cross-device traffic — all of it
+    O(K*F*Bmax*CH), independent of the row count — is
       * a psum of the K per-shard left counts, so the smaller/larger-child
         choice and the subtraction pool key off GLOBAL row counts
         (SyncUpGlobalBestSplit semantics, parallel_tree_learner.h:209);
@@ -172,11 +183,42 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
     tree. The histogram pool turns feature-major ([L+1, f_local, Bmax, CH]
     raw reduced blocks) and is paired with replicated raw leaf totals +
     global leaf counts so subtraction works on already-reduced data.
+
+    mode="voting" — rows sharded like "data", but the histogram pool keeps
+    the LOCAL group layout and the full reduction is replaced by PV-Tree
+    two-phase voting (voting_parallel_tree_learner.cpp, arxiv 1611.01276):
+    each device scans its local feature histograms, nominates its top-k
+    features per candidate leaf, one tiny all_gather of the nomination ids
+    elects the global top-2k by vote count (deterministic and replicated),
+    and ONLY the elected features' raw histogram slices cross the wire via
+    a gathered psum before a replicated rescan commits a true global
+    argmax over the candidate set. Per-wave ICI volume is
+    O(K*(D*k + 2k*Bmax*CH)) — independent of F. The K smaller children
+    are nominated/elected/reduced BEFORE the pool subtraction produces the
+    K larger children (double-buffered dispatch): the first slice psum is
+    in flight while the subtraction runs, which is what the
+    `device_ici_overlap_pct` gauge prices. `exact_check` additionally runs
+    the full reduction each scan and counts elected-vs-exact best-feature
+    disagreements (the `voting_miss_total` counter, returned as a sixth
+    output); `skew` is the vote_skew fault hook — (rank, wave) traced
+    scalars, -1 to disarm.
+
+    mode="feature" — rows REPLICATED (feature_parallel_tree_learner.cpp):
+    every device builds the full local histogram and partitions
+    identically; only the split scan is feature-sharded (meta holds this
+    device's block) and the single collective per scan is the [2K, D, REC]
+    best-record all_gather — O(2K*REC), independent of rows AND features.
     """
     L = num_leaves
     G, N = bins.shape
     CH = gh.shape[1]
     K = max(1, min(batch, L))
+    voting = sharded and mode == "voting"
+    feature_par = sharded and mode == "feature"
+    data_par = sharded and mode == "data"
+    # "data" and "voting" shard the rows; "feature" replicates them and
+    # shards only the scan
+    row_sharded = sharded and not feature_par
     min_data, min_hess = params[2], params[3]
     neg_inf = jnp.float32(-jnp.inf)
     from ..ops.compact_pallas import (COMPACT_TILE, compact_rows,
@@ -293,7 +335,7 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         h = build_histogram(bins_c[:G], ghK, num_bins)
         return h.astype(pool_dtype)  # quantized: exact ints below 2**24
 
-    if sharded:
+    if data_par:
         gidx, vslot, sm = meta.gather_index, meta.valid_slot, meta.scan
         F_pad, Bmax = gidx.shape
         f_local = sm.default_bin.shape[0]
@@ -340,6 +382,125 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
             best = jax.vmap(reduce_best_record)(recs)
             return jax.vmap(guard)(best, tot[:, 2], tot[:, 1], depths)
 
+    if voting:
+        gidx, vslot, sm_full = meta.gather_index, meta.valid_slot, meta.scan
+        F_pad, Bmax = gidx.shape
+        k_local = max(1, min(top_k, F_pad))
+        k_global = max(1, min(2 * top_k, F_pad))
+
+        def _scaled(a):
+            if quantized:
+                return a.astype(jnp.float32) * scale_vec
+            return a
+
+        def _fix_scan(fh, tot):
+            """Scaled feature hists + matching totals -> [*, F_pad, REC]
+            per-feature records (EFB fix commutes with the reduction, so
+            fixing local hists with local totals and reduced hists with
+            global totals yields consistent values)."""
+            fh = jax.vmap(lambda b, t: fix_feature_hist(
+                b, t, sm_full.efb_omitted, sm_full.default_bin))(fh, tot)
+            return jax.vmap(lambda b, t: per_feature_best(
+                b, t, sm_full, params, feature_mask))(fh, tot)
+
+        def vote_scan(hists_k, tot_raw, depths, wave_no):
+            """[k, G, B, CH] raw LOCAL group hists + [k, CH] raw GLOBAL
+            totals -> ([k, REC] guarded globally-best records over the
+            ELECTED candidate set, disagreement count).
+
+            PV-Tree two-phase voting: local full-F scan -> top-k
+            nomination -> all_gather + vote count -> replicated top-2k
+            election (jax.lax.top_k ties break to the LOWER index and the
+            elected set is sorted, so top_k >= F elects arange(F) and the
+            rescan is bit-identical to a full scan) -> psum of ONLY the
+            elected raw slices -> replicated rescan."""
+            kk = hists_k.shape[0]
+            fh_raw = jax.vmap(lambda h: gather_feature_hist_raw(
+                h, gidx, vslot))(hists_k)  # [k, F_pad, Bmax, CH] raw local
+            loc_tot_raw = hists_k[:, 0].sum(axis=1)  # [k, CH] raw local
+            local_recs = _fix_scan(_scaled(fh_raw), _scaled(loc_tot_raw))
+            # phase 1 (LocalVoting): nominate the local top-k by local gain
+            _, nom = jax.lax.top_k(local_recs[:, :, 0], k_local)  # [k, kl]
+            if skew is not None:
+                # vote_skew@R:K fault: this rank's nominations are garbage
+                # at the armed wave (highest feature ids — the padded/inert
+                # tail), modelling a worker whose local scan is corrupted
+                hit = ((jax.lax.axis_index("data") == skew[0])
+                       & (wave_no == skew[1]))
+                garbage = (F_pad - 1 - jnp.arange(k_local, dtype=nom.dtype)
+                           ) % F_pad
+                nom = jnp.where(hit, jnp.broadcast_to(garbage[None, :],
+                                                      nom.shape), nom)
+            votes = jax.lax.all_gather(nom, "data", axis=1,
+                                       tiled=True)  # [k, D*kl]
+            counts = jax.vmap(lambda v: jnp.zeros(
+                (F_pad,), jnp.int32).at[v].add(1))(votes)
+            # phase 2 (GlobalVoting): elect the top-2k by vote count —
+            # replicated inputs, deterministic ties, ascending elected ids
+            _, selected = jax.lax.top_k(counts, k_global)  # [k, kg]
+            selected = jnp.sort(selected, axis=1)
+            sel_raw = jnp.take_along_axis(
+                fh_raw, selected[:, :, None, None], axis=1)
+            if narrow:
+                sel_raw = sel_raw.astype(jnp.int16)
+            sel_red = jax.lax.psum(sel_raw, "data").astype(pool_dtype)
+            tot = _scaled(tot_raw)
+
+            def rescan(blk, idx, t):
+                m = jax.tree_util.tree_map(lambda a: a[idx], sm_full)
+                blk = fix_feature_hist(blk, t, m.efb_omitted, m.default_bin)
+                recs = per_feature_best(blk, t, m, params,
+                                        feature_mask[idx])
+                feat = recs[:, 1]
+                gid = idx[jnp.maximum(feat.astype(jnp.int32), 0)].astype(
+                    jnp.float32)
+                recs = recs.at[:, 1].set(jnp.where(feat >= 0, gid, -1.0))
+                return reduce_best_record(recs)
+
+            best = jax.vmap(rescan)(_scaled(sel_red), selected, tot)
+            best = jax.vmap(guard)(best, tot[:, 2], tot[:, 1], depths)
+            if not exact_check:
+                return best, jnp.int32(0)
+            # LGBM_TPU_VOTING_EXACT_CHECK=1: also run the full reduction
+            # the vote avoided and count best-feature disagreements (the
+            # documented approximation: the exact best can be un-nominated)
+            full_raw = fh_raw.astype(jnp.int16) if narrow else fh_raw
+            full = jax.lax.psum(full_raw, "data").astype(pool_dtype)
+            frecs = _fix_scan(_scaled(full),
+                              jnp.broadcast_to(tot, (kk, CH)))
+            fbest = jax.vmap(reduce_best_record)(frecs)
+            fbest = jax.vmap(guard)(fbest, tot[:, 2], tot[:, 1], depths)
+            miss = jnp.sum(((fbest[:, 0] > 0)
+                            & (fbest[:, 1] != best[:, 1])).astype(jnp.int32))
+            return best, miss
+
+    if feature_par:
+        gidx, vslot, sm = meta.gather_index, meta.valid_slot, meta.scan
+        f_local = sm.default_bin.shape[0]
+        shard_off = (jax.lax.axis_index("data") * f_local).astype(
+            jnp.float32)
+
+        def feature_scan(hists_k, tots, depths):
+            """[k, G, B, CH] replicated raw group hists + [k, CH] scaled
+            totals -> [k, REC] guarded best records: every device gathers
+            and scans its OWN feature block of the full local histogram;
+            the only cross-device traffic is the [k, D, REC] best-record
+            all_gather (FeatureParallelTreeLearner semantics)."""
+            fh = jax.vmap(lambda h: gather_feature_hist_raw(
+                scan_hist(h), gidx, vslot))(hists_k)
+            fh = jax.vmap(lambda b, t: fix_feature_hist(
+                b, t, sm.efb_omitted, sm.default_bin))(fh, tots)
+            recs = jax.vmap(lambda b, t: per_feature_best(
+                b, t, sm, params, feature_mask))(fh, tots)
+            feat = recs[:, :, 1]
+            recs = recs.at[:, :, 1].set(
+                jnp.where(feat >= 0, feat + shard_off, -1.0))
+            best = jax.vmap(reduce_best_record)(recs)  # [k, REC] local
+            allr = jax.lax.all_gather(best[:, None], "data", axis=1,
+                                      tiled=True)  # [k, D, REC]
+            best = jax.vmap(reduce_best_record)(allr)
+            return jax.vmap(guard)(best, tots[:, 2], tots[:, 1], depths)
+
     # --- initial compaction: in-bag rows to the front, root = [0, n_in)
     if bagged:
         in_bag = leaf_id0 == 0
@@ -351,7 +512,7 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
             bins_p, row_p, dst0, [in_bag, ~in_bag],
             jnp.ones(Np, bool), tile=COMPACT_TILE,
             use_pallas=use_kernels, interpret=interp)
-    elif sharded:
+    elif row_sharded:
         # the learner's global row padding trails the real rows, so every
         # shard's real rows are already contiguous from 0 — count, don't
         # compact
@@ -371,7 +532,7 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
 
     depth = jnp.zeros(L + 1, jnp.int32)
     leaf_best = jnp.full((L + 1, REC), neg_inf, jnp.float32)
-    if sharded:
+    if data_par:
         root_tot_raw = jax.lax.psum(root_hist[0].sum(axis=0), "data")
         n_in_g = jax.lax.psum(n_in, "data")
         pool = jnp.zeros((L + 1, f_local, Bmax, CH), pool_dtype).at[0].set(
@@ -380,13 +541,31 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         count_g = jnp.zeros(L + 1, jnp.int32).at[0].set(n_in_g)
         root_rec = scan_blocks(pool[0][None], root_tot_raw[None],
                                jnp.zeros(1, jnp.int32))[0]
+    elif voting:
+        # the pool keeps the LOCAL raw group layout — no feature-blocked
+        # histogram crosses the wire until the vote elects its slice
+        root_tot_raw = jax.lax.psum(root_hist[0].sum(axis=0), "data")
+        n_in_g = jax.lax.psum(n_in, "data")
+        pool = jnp.zeros((L + 1, G, num_bins, CH), pool_dtype).at[0].set(
+            root_hist)
+        tpool = jnp.zeros((L + 1, CH), pool_dtype).at[0].set(root_tot_raw)
+        count_g = jnp.zeros(L + 1, jnp.int32).at[0].set(n_in_g)
+        root_rec, root_miss = vote_scan(
+            root_hist[None].astype(pool_dtype), root_tot_raw[None],
+            jnp.zeros(1, jnp.int32), jnp.int32(0))
+        root_rec = root_rec[0]
     else:
         root_tot = hist_totals(root_hist)
         pool = jnp.zeros((L + 1, G, num_bins, CH), pool_dtype).at[0].set(
             root_hist)
-        root_rec = guard(find_best_split(scan_hist(root_hist), root_tot,
-                                         meta, params, feature_mask),
-                         root_tot[2], root_tot[1], jnp.int32(0))
+        if feature_par:
+            root_rec = feature_scan(root_hist[None].astype(pool_dtype),
+                                    root_tot[None],
+                                    jnp.zeros(1, jnp.int32))[0]
+        else:
+            root_rec = guard(find_best_split(scan_hist(root_hist), root_tot,
+                                             meta, params, feature_mask),
+                             root_tot[2], root_tot[1], jnp.int32(0))
     leaf_best = leaf_best.at[0].set(root_rec)
     # one extra dump row at the end for masked-out replay writes
     rec_store = jnp.zeros((max(L - 1, 1) + 1, STORE), jnp.float32)
@@ -394,7 +573,10 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
     l1, l2, max_delta = params[0], params[1], params[5]
 
     def wave(carry):
-        if sharded:
+        if voting:
+            (bins_p, row_p, start, count, depth, leaf_best, rec_store, pool,
+             n_cur, t, hist_rows, tpool, count_g, miss, n_waves) = carry
+        elif data_par:
             (bins_p, row_p, start, count, depth, leaf_best, rec_store, pool,
              n_cur, t, hist_rows, tpool, count_g, n_waves) = carry
         else:
@@ -467,7 +649,7 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         # --- ragged histogram of ONLY the smaller children; tie -> left,
         # matching the serial learner's _apply_split choice
         nr_k = c_k - nl_k
-        if sharded:
+        if row_sharded:
             # smaller/larger child by GLOBAL row counts (psum of the
             # per-shard left counts — SyncUpGlobalBestSplit semantics):
             # every device histograms its LOCAL rows of the globally
@@ -493,7 +675,7 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
             histS.reshape(G, num_bins, K, CH), 2, 0)  # [K, G, B, CH]
         child_depth = depth[sel] + 1  # [K]
         depth2 = jnp.repeat(child_depth, 2)  # [2K]
-        if sharded:
+        if data_par:
             # global raw totals of the smaller children, then ONE
             # psum_scatter merges the raw gathered feature hists into this
             # device's reduced block; subtraction happens on reduced data
@@ -515,6 +697,35 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
             if quantized:
                 totals = totals.astype(jnp.float32) * scale_vec[None, :]
             recs2 = scan_blocks(hists, tot2_raw, depth2)
+        elif voting:
+            # double-buffered dispatch: elect + reduce the SMALLER children
+            # first, so their nomination gather and elected-slice psum are
+            # in flight while the larger-child subtraction runs on local
+            # data — the overlapped half of the wave's ICI traffic
+            # (device_ici_overlap_pct)
+            totS_raw = jax.lax.psum(histS_k[:, 0].sum(axis=1), "data")
+            histSblk = histS_k.astype(pool_dtype)
+            recsS, missS = vote_scan(histSblk, totS_raw, child_depth,
+                                     n_waves)
+            pool_sel = jnp.take(pool, sel, axis=0)  # [K, G, B, CH] local
+            tp_sel = jnp.take(tpool, sel, axis=0)  # [K, CH] global raw
+            histB = pool_sel - histSblk  # the bigger sibling, local raw
+            totB_raw = tp_sel - totS_raw
+            recsB, missB = vote_scan(histB, totB_raw, child_depth, n_waves)
+            miss = miss + missS + missB
+            histL = jnp.where(left_small[:, None, None, None], histSblk,
+                              histB)
+            histR = pool_sel - histL
+            totL_raw = jnp.where(left_small[:, None], totS_raw, totB_raw)
+            totR_raw = tp_sel - totL_raw
+            recsL = jnp.where(left_small[:, None], recsS, recsB)
+            recsR = jnp.where(left_small[:, None], recsB, recsS)
+            recs2 = jnp.stack([recsL, recsR], axis=1).reshape(2 * K, REC)
+            tot2_raw = jnp.stack([totL_raw, totR_raw], axis=1).reshape(
+                2 * K, CH)
+            totals = tot2_raw
+            if quantized:
+                totals = totals.astype(jnp.float32) * scale_vec[None, :]
         else:
             pool_sel = jnp.take(pool, sel, axis=0)  # [K, G, B, CH]
             histL = jnp.where(left_small[:, None, None, None], histS_k,
@@ -525,12 +736,15 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
             totals = hists[:, 0].sum(axis=1)  # bins-summed -> [2K, CH]
             if quantized:
                 totals = totals.astype(jnp.float32) * scale_vec[None, :]
-            recs2 = jax.vmap(
-                lambda h, tot: find_best_split(scan_hist(h), tot, meta,
-                                               params, feature_mask))(
-                hists, totals)
-            recs2 = jax.vmap(guard)(recs2, totals[:, 2], totals[:, 1],
-                                    depth2)
+            if feature_par:
+                recs2 = feature_scan(hists, totals, depth2)
+            else:
+                recs2 = jax.vmap(
+                    lambda h, tot: find_best_split(scan_hist(h), tot, meta,
+                                                   params, feature_mask))(
+                    hists, totals)
+                recs2 = jax.vmap(guard)(recs2, totals[:, 2], totals[:, 1],
+                                        depth2)
 
         # --- exact best-first replay over the precomputed set
         def replay_step(_, rp):
@@ -595,9 +809,9 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         mid_k = s_k + nl_k
         start = start.at[wnK].set(mid_k)
         count = count.at[wnK].set(nr_k).at[wbK].set(nl_k)
-        if sharded:
-            # replicated raw totals + GLOBAL counts ride with the feature-
-            # block pool so later subtractions stay reduction-free
+        if row_sharded:
+            # replicated raw totals + GLOBAL counts ride with the pool so
+            # later subtractions stay reduction-free
             tpool = tpool.at[wbK].set(totL_raw).at[wnK].set(totR_raw)
             count_g = count_g.at[wnK].set(nr_g).at[wbK].set(nl_g)
 
@@ -610,7 +824,11 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
         is_right = com_row & (pos >= rowsP[:, 2].astype(jnp.int32))
         leafcol = jnp.where(is_right, rowsP[:, 1], row_p[:, LEAF_COL])
         row_p = row_p.at[:, LEAF_COL].set(leafcol)
-        if sharded:
+        if voting:
+            return (bins_p, row_p, start, count, depth, leaf_best,
+                    rec_store, pool, n_cur, t, hist_rows, tpool, count_g,
+                    miss, n_waves)
+        if data_par:
             return (bins_p, row_p, start, count, depth, leaf_best,
                     rec_store, pool, n_cur, t, hist_rows, tpool, count_g,
                     n_waves)
@@ -623,21 +841,26 @@ def _grow_impl(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
 
     carry = (bins_p, row_p, start, count, depth, leaf_best, rec_store, pool,
              jnp.int32(1), jnp.int32(0), hist_rows)
-    if sharded:
+    if row_sharded:
         carry = carry + (tpool, count_g)
+    if voting:
+        carry = carry + (root_miss,)
     carry = carry + (jnp.int32(0),)  # n_waves, last so indices above hold
     if L > 1:
         carry = jax.lax.while_loop(cond, wave, carry)
     row_p, rec_store, n_cur, hist_rows = carry[1], carry[6], carry[8], \
         carry[10]
     n_waves = carry[-1]
-    if sharded:
+    if row_sharded:
         hist_rows = jax.lax.psum(hist_rows, "data")
     # undo the permutation without a TPU scatter: sort leaf ids by the
     # original-position column (both exact small ints in f32)
     _, leaf_sorted = jax.lax.sort_key_val(
         row_p[:, POS_COL].astype(jnp.int32),
         row_p[:, LEAF_COL].astype(jnp.int32))
+    if voting:
+        return (rec_store[:-1], leaf_sorted[:N], n_cur, hist_rows, n_waves,
+                carry[13])
     return rec_store[:-1], leaf_sorted[:N], n_cur, hist_rows, n_waves
 
 
@@ -690,12 +913,18 @@ def grow_tree_on_device(bins: jax.Array, gh: jax.Array, leaf_id0: jax.Array,
                       bagged=bagged, sharded=False, narrow=False)
 
 
+# graftlint: disable=untimed-hot-func -- builder only defines the shard_map/jit closure; real cost is lazy trace+compile inside the timed tree_device scope every caller runs under
 def make_sharded_grow_fn(mesh, *, num_leaves: int, num_bins: int,
                          max_depth: int, quantized: bool, batch: int,
-                         bagged: bool, narrow: bool = False):
-    """jit(shard_map) whole-tree grower, data-parallel over the "data" mesh
-    axis: one dispatch per tree across every device.
+                         bagged: bool, narrow: bool = False,
+                         mode: str = "data", top_k: int = 0,
+                         exact_check: bool = False):
+    """jit(shard_map) whole-tree grower over the "data" mesh axis: one
+    dispatch per tree across every device.
 
+    Three modes (the tree_learner config knob):
+
+    mode="data" — rows sharded, scan feature-sharded by ONE psum_scatter.
     Call signature of the returned fn (all arrays GLOBAL, rows padded by
     the caller to a per-shard multiple of the wave tile unit so each
     device's shard needs no further padding):
@@ -707,6 +936,20 @@ def make_sharded_grow_fn(mesh, *, num_leaves: int, num_bins: int,
 
     bins/gh/leaf_id0/feature_mask arrive row-/feature-sharded on "data";
     gather tables, decision tables, params and scale_vec replicated.
+
+    mode="voting" — rows sharded like "data", but gather tables, scan_meta
+    and feature_mask arrive REPLICATED over the FULL padded feature axis
+    (every device scans all features locally; only elected slices are
+    reduced — PV-Tree, `top_k` nominations per shard). Two extra trailing
+    scalar args (skew_rank, skew_wave — int32, -1 disarmed) drive the
+    vote_skew fault hook, and the returned tuple gains a trailing
+    replicated `miss` count (non-zero only when exact_check=True).
+
+    mode="feature" — bins/gh/leaf_id0 arrive REPLICATED (and unpadded:
+    the internal padding handles them exactly like the single-device
+    path) while gather tables, scan_meta and feature_mask arrive
+    feature-sharded; the only collective is the best-record all_gather.
+
     scale_vec must be a real array even when quantized=False (pass ones —
     it is ignored). Categorical splits are not supported here (the factory
     routes categorical configs to the host-driven learners). Returns the
@@ -715,6 +958,50 @@ def make_sharded_grow_fn(mesh, *, num_leaves: int, num_bins: int,
     replicated.
     """
     from jax.sharding import PartitionSpec as P
+
+    if mode == "voting":
+        def body(bins, gh, leaf_id0, gather_index, valid_slot, scan_meta,
+                 tables, params, feature_mask, scale_vec, skew_rank,
+                 skew_wave):
+            meta = ShardMeta(gather_index, valid_slot, scan_meta)
+            return _grow_impl(bins, gh, leaf_id0, meta, tables, params,
+                              feature_mask,
+                              scale_vec if quantized else None,
+                              num_leaves=num_leaves, num_bins=num_bins,
+                              max_depth=max_depth, quantized=quantized,
+                              batch=batch, bagged=bagged, sharded=True,
+                              narrow=narrow, mode="voting", top_k=top_k,
+                              exact_check=exact_check,
+                              skew=(skew_rank, skew_wave))
+
+        return jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, "data"), P("data"), P("data"), P(), P(),
+                      P(), P(), P(), P(), P(), P(), P()),
+            out_specs=(P(), P("data"), P(), P(), P(), P()),
+            check_vma=False), donate_argnums=(0, 1, 2))
+
+    if mode == "feature":
+        def body(bins, gh, leaf_id0, gather_index, valid_slot, scan_meta,
+                 tables, params, feature_mask, scale_vec):
+            meta = ShardMeta(gather_index, valid_slot, scan_meta)
+            return _grow_impl(bins, gh, leaf_id0, meta, tables, params,
+                              feature_mask,
+                              scale_vec if quantized else None,
+                              num_leaves=num_leaves, num_bins=num_bins,
+                              max_depth=max_depth, quantized=quantized,
+                              batch=batch, bagged=bagged, sharded=True,
+                              narrow=False, mode="feature")
+
+        # no donation: the replicated row arrays arrive unpadded, so their
+        # buffers never match the padded loop carries anyway (donating
+        # them only buys a "not usable" warning)
+        return jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(), P(), P("data"), P("data"), P("data"),
+                      P(), P(), P("data"), P()),
+            out_specs=(P(), P(), P(), P(), P()),
+            check_vma=False))
 
     def body(bins, gh, leaf_id0, gather_index, valid_slot, scan_meta,
              tables, params, feature_mask, scale_vec):

@@ -36,6 +36,17 @@ elastic restart that resumes at the fault iteration does not re-die:
                         sugar for worker_kill@0:K
     slow_worker@R:ms    rank R sleeps `ms` milliseconds at the start of
                         every iteration (straggler; fires every attempt)
+    vote_skew@R:K       device-voting MESH rank R nominates garbage
+                        features at wave K (its PV-Tree ballot is
+                        corrupted; ranks here are mesh axis positions, so
+                        single-process fake-device meshes inject too).
+                        LGBM_TPU_VOTING_EXACT_CHECK=1 surfaces it as
+                        VotingDivergenceError with the measured election
+                        divergence attached; under an elastic gang without
+                        exact-check the detecting worker parks in the
+                        interruptible watchdog spin instead — the same
+                        WorkerLostError conversion path as worker_hang,
+                        never a silent hang
 
     slow_predict@N[:secs]    every device dispatch from the Nth onward
                              sleeps `secs` (default 0.05) before running —
@@ -84,6 +95,15 @@ class InjectedFault(RuntimeError):
     (the checkpoint files on disk are all a real kill would leave)."""
 
 
+class VotingDivergenceError(RuntimeError):
+    """Raised by the voting exact-check harness when an armed vote_skew
+    plan corrupted a PV-Tree ballot: the typed surface for election
+    tampering (the message carries the measured committed-split
+    divergence, which can legitimately be 0 — a single corrupted ballot is
+    often outvoted — but a tampered election must never train on
+    silently)."""
+
+
 # exit code an injected worker_kill uses under gang supervision — distinct
 # from real crash codes so the supervisor log names the injection
 EXIT_INJECTED_KILL = 43
@@ -128,6 +148,7 @@ class FaultPlan:
         self.worker_kill = None   # (rank, iteration)
         self.worker_hang = None   # (rank, iteration)
         self.slow_worker = None   # (rank, seconds)
+        self.vote_skew = None     # (mesh rank, wave)
         self.slow_predict_at: Optional[int] = None
         self.slow_predict_s = 0.05
         self.fail_predict_at: Optional[int] = None
@@ -183,6 +204,8 @@ class FaultPlan:
             elif token.startswith("slow_worker@"):
                 r, ms = _rank_iter(token, "slow_worker@", value=float)
                 self.slow_worker = (r, ms / 1e3)
+            elif token.startswith("vote_skew@"):
+                self.vote_skew = _rank_iter(token, "vote_skew@")
             elif token.startswith("drift_shift@"):
                 self.drift_shift = _rank_iter(token, "drift_shift@")
             elif token.startswith("bad_generation@"):
@@ -276,6 +299,55 @@ def check_distributed(iteration: int) -> None:
             rt = elastic.active()
             if rt is not None:
                 rt.poll_raise()
+
+
+def vote_skew_params():
+    """(mesh_rank, wave) of an armed vote_skew plan, else None. The voting
+    learner threads these into the grower as traced scalars; inside the
+    vote the nomination row of mesh rank `mesh_rank` is replaced with
+    garbage at wave `wave`. Ranks are mesh axis positions (not
+    JAX_PROCESS_ID), so a single-process fake-device mesh injects too."""
+    return _get().vote_skew
+
+
+def check_vote_skew_surfaced(miss_total: int, exact_check: bool) -> None:
+    """Post-tree hook in the voting learner's finalize: an armed vote_skew
+    plan must surface as a TYPED error, never a hang or a silent quality
+    loss. Exact-check mode is the detector harness — it aborts with
+    VotingDivergenceError carrying the measured election divergence.
+    Without exact-check, under an elastic gang, the detecting worker parks
+    in the interruptible watchdog spin until the supervisor declares it
+    lost — the same WorkerLostError conversion path as worker_hang. With
+    neither armed the corruption only shifts split quality, which the
+    exact-check counter exists to measure. One-shot, like every
+    injection."""
+    p = _get()
+    if p.vote_skew is None or not p.once("vote_skew"):
+        return
+    rank, wave = p.vote_skew
+    _emit_fault("vote_skew", rank=rank, wave=wave, miss=int(miss_total),
+                exact_check=exact_check)
+    if exact_check:
+        raise VotingDivergenceError(
+            f"injected fault: vote_skew@{rank}:{wave} corrupted a PV-Tree "
+            f"ballot ({int(miss_total)} committed-split disagreement(s) "
+            "counted by the exact check)")
+    import time
+
+    from ..parallel import elastic
+    if elastic.active() is None:
+        Log.warning("vote_skew@%d:%d armed without exact-check or an "
+                    "elastic gang: corruption measured nowhere (arm "
+                    "LGBM_TPU_VOTING_EXACT_CHECK=1 to count it)",
+                    rank, wave)
+        return
+    Log.warning("Fault injection: vote_skew@%d:%d under an elastic gang — "
+                "parking in the watchdog spin", rank, wave)
+    while True:
+        time.sleep(0.01)
+        rt = elastic.active()
+        if rt is not None:
+            rt.poll_raise()
 
 
 def maybe_poison_gh(grads, hesses, iteration: int):

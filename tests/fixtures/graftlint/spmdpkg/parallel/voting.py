@@ -1,0 +1,63 @@
+"""R7/R11/R12 plants at the PV-Tree voting collective shapes (the round-9
+learners): the nomination gather, the elected-slice psum and the overlap
+dispatch, next to their compliant shard_map-wrapped forms. Exact-line
+assertions live in tests/test_lint_spmd.py (voting section).
+"""
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from .divergent import _sync
+
+
+def _nominate(local_recs):
+    # clean: 'data' flows from the shard_map around _vote_body
+    return jax.lax.all_gather(local_recs, "data", axis=1, tiled=True)
+
+
+def _elected_psum(slices):
+    return jax.lax.psum(slices, "data")
+
+
+def _vote_body(hist, recs):
+    return _elected_psum(hist), _nominate(recs)
+
+
+def vote_wave(mesh, hist, recs):
+    # clean: the wrap binds 'data' for the whole body chain
+    return shard_map(_vote_body, mesh=mesh, in_specs=(P("data"), P("data")),
+                     out_specs=(P(), P("data")))(hist, recs)
+
+
+@jax.jit
+def rescan_entry(hist):
+    # R11: this second path to the elected-slice psum binds no mesh axis —
+    # tracing the jitted rescan without the vote's shard_map fails
+    return _elected_psum(hist)
+
+
+def skewed_gather(nom):
+    return jax.lax.all_gather(nom, "vote", axis=1, tiled=True)  # R7: unbound
+
+
+def overlap_dispatch(small, pool):
+    if jax.process_index() == 0:  # R12(a): only rank 0 posts the elected psum
+        small = _elected_psum(small)
+    return pool - small
+
+
+def overlap_wave(mesh, small, pool):
+    # the dispatch IS bound (so R7/R11 stay quiet): only the collective-
+    # SEQUENCE divergence above is the plant
+    return shard_map(overlap_dispatch, mesh=mesh,
+                     in_specs=(P("data"), P("data")),
+                     out_specs=P())(small, pool)
+
+
+def gathered_commit(best):
+    return _sync(best)  # clean: reuses the compliant helper across modules
+
+
+def commit_wave(mesh, best):
+    return shard_map(gathered_commit, mesh=mesh, in_specs=(P("data"),),
+                     out_specs=P())(best)

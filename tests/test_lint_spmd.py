@@ -88,6 +88,44 @@ def test_r12_covering_entry_stays_quiet(result):
     assert not lines & {18, 19}
 
 
+# -- the round-9 voting-learner collective shapes (R7/R11/R12) ------------
+
+def test_voting_unbound_nomination_gather_flagged(result):
+    # skewed_gather posts the nomination all_gather over an axis no
+    # shard_map in the module binds
+    bad = _hits(result, "collective-axis", "parallel/voting.py")
+    assert [v.line for v in bad] == [40]
+    assert "all_gather over axis 'vote'" in bad[0].message
+
+
+def test_voting_unbound_context_paths_flagged(result):
+    # two R11 paths to the elected-slice collectives: the jitted rescan
+    # (no mesh context at its jit boundary) and the skewed gather root
+    bad = _hits(result, "collective-context", "parallel/voting.py")
+    assert sorted(v.line for v in bad) == [32, 39]
+    by_line = {v.line: v.message for v in bad}
+    assert "jit boundary" in by_line[32]
+    assert "axis 'data'" in by_line[32]
+    assert "entry point" in by_line[39]
+    assert "axis 'vote'" in by_line[39]
+
+
+def test_voting_overlap_dispatch_divergence_flagged(result):
+    # overlap_dispatch posts the elected psum on rank 0 only
+    bad = _hits(result, "collective-order", "parallel/voting.py")
+    assert [v.line for v in bad] == [44]
+    assert "[psum@data] vs []" in bad[0].message
+
+
+def test_voting_wrapped_waves_stay_quiet(result):
+    # vote_wave / overlap_wave / commit_wave bind 'data' via shard_map:
+    # nothing beyond the three planted shapes fires in the module
+    lines = {(v.rule, v.line) for v in result.violations
+             if v.path == "parallel/voting.py"}
+    assert lines == {("collective-axis", 40), ("collective-context", 32),
+                     ("collective-context", 39), ("collective-order", 44)}
+
+
 # -- R13 blocking work under a held lock ----------------------------------
 
 def test_r13_blocking_under_lock_flagged(result):

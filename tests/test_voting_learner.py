@@ -1,0 +1,458 @@
+"""Pod-scale learners on the 8 fake CPU devices conftest forces: the
+PV-Tree voting data-parallel learner and the feature-parallel learner
+(ISSUE 18, arxiv 1611.01276 semantics).
+
+Correctness strategy mirrors tests/test_sharded_device.py:
+
+* top_k >= F elects EVERY feature (the sorted election index equals
+  arange(F_pad)), so the voting rescan degenerates to the exact
+  data-parallel reduction and the whole split log must be bit-identical
+  to the single-device wave learner. Feature-parallel is exact by
+  construction (disjoint blocks + tie-break toward the lowest device =
+  lowest feature range), so it joins the same bit-identity matrix.
+* small top_k is a DOCUMENTED approximation: quality is pinned against
+  the exact learner (AUC within 1e-3 / L2 within 2%), and
+  LGBM_TPU_VOTING_EXACT_CHECK=1 runs the full reduction alongside and
+  counts committed-split disagreements (voting_miss_total).
+
+Plus the comm-model gauges (voting ICI independent of F, feature ICI
+independent of N, voting <= 1/4 of data-parallel at F=256/top_k=20), the
+satellite int16-packing bugfix (decision keyed off the psum'd GLOBAL bag
+count, never a shard-local view), the elastic-gang story (kill mid-train
+surfaces WorkerLostError; shrink-to-fit resume re-shards the vote
+bit-identically), and the vote_skew fault token (typed error, not a
+hang, with and without the exact check).
+"""
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.engine import train
+from lightgbm_tpu.io.dataset import Dataset as CoreDataset
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.parallel import elastic
+from lightgbm_tpu.parallel.elastic import WorkerLostError
+from lightgbm_tpu.parallel.learners import (DeviceDataParallelTreeLearner,
+                                            DeviceFeatureParallelTreeLearner,
+                                            VotingDataParallelTreeLearner)
+from lightgbm_tpu.treelearner.device import DeviceTreeLearner
+from lightgbm_tpu.utils import faults
+from lightgbm_tpu.utils.faults import VotingDivergenceError
+from lightgbm_tpu.utils.timer import global_timer
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.clear()
+    elastic.clear()
+
+
+def _snap(v):
+    """Snap to the 2^-10 grid: f32 sums of ~1k such values are exact in
+    any association order (see test_sharded_device.py)."""
+    return np.round(np.clip(v, -1.0, 1.0) * 1024.0) / 1024.0
+
+
+def _snapped_gh(rng, n):
+    g = _snap(rng.uniform(-1.0, 1.0, n)).astype(np.float32)
+    h = _snap(rng.uniform(0.25, 1.0, n)).astype(np.float32)
+    gh = np.stack([g, h, np.ones(n, np.float32)], axis=1)
+    return jnp.asarray(np.concatenate([gh, np.zeros((1, 3), np.float32)]))
+
+
+def _learner(cls, X, y, params):
+    cfg = Config(params)
+    ds = CoreDataset.from_matrix(X, label=y, config=cfg)
+    return cls(cfg, ds)
+
+
+def _auc(y, score):
+    order = np.argsort(np.asarray(score))
+    ranks = np.empty(len(y))
+    ranks[order] = np.arange(1, len(y) + 1)
+    pos = y > 0
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def _split_log(cls, X, y, params, gh, bag=None):
+    learner = _learner(cls, X, y, params)
+    pending = learner.train_async(gh, bag)
+    log = np.asarray(pending.rec_store)
+    learner.finalize(pending)
+    return log, np.asarray(learner.partition.ids_host)
+
+
+def _assert_same_log(a, b):
+    # col 4 is the packed gain scalar — 1-ulp XLA fusion wobble between
+    # the two compiled programs; every decision-bearing column is exact
+    gain_col = 4
+    np.testing.assert_allclose(a[0][:, gain_col], b[0][:, gain_col],
+                               rtol=1e-6)
+    mask = np.ones(a[0].shape[1], bool)
+    mask[gain_col] = False
+    np.testing.assert_array_equal(a[0][:, mask], b[0][:, mask])
+    np.testing.assert_array_equal(a[1], b[1])
+
+
+# ------------------------------------------------- top_k >= F bit-identity
+
+@pytest.mark.parametrize("bagged", [False, True])
+@pytest.mark.parametrize("cls", [VotingDataParallelTreeLearner,
+                                 DeviceFeatureParallelTreeLearner])
+def test_topk_ge_f_bit_identical_to_single_device(rng, cls, bagged):
+    """top_k=64 >= F_pad: the election keeps every feature, so the voting
+    learner must reproduce the single-device wave learner's split log and
+    row->leaf map bit for bit (and feature-parallel always must)."""
+    n = 1100
+    X = rng.randn(n, 7)
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(float)
+    gh = _snapped_gh(rng, n)
+    params = {"objective": "binary", "num_leaves": 15, "top_k": 64,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    bag = (np.sort(np.random.RandomState(3).choice(n, 800, replace=False))
+           .astype(np.int32) if bagged else None)
+    base = _split_log(DeviceTreeLearner, X, y, params, gh, bag)
+    _assert_same_log(base, _split_log(cls, X, y, params, gh, bag))
+
+
+@pytest.mark.slow
+def test_voting_quantized_driver_bit_identical(rng):
+    """Quantized regime through the FULL driver: int32 slice reduction is
+    order-exact, so with top_k >= F the voting booster matches the exact
+    data-parallel booster's predictions bit for bit."""
+    n = 1200
+    X = rng.randn(n, 6)
+    y = (X[:, 0] - 0.6 * X[:, 1] + rng.randn(n) * 0.3 > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "top_k": 64, "use_quantized_grad": True,
+              "quant_train_renew_leaf": True}
+    preds = []
+    for cls in (DeviceDataParallelTreeLearner, VotingDataParallelTreeLearner):
+        cfg = Config(params)
+        ds = CoreDataset.from_matrix(X, label=y, config=cfg)
+        bst = GBDT(cfg, ds, create_objective("binary", cfg))
+        bst.tree_learner = cls(cfg, ds)
+        for _ in range(4):
+            if bst.train_one_iter():
+                break
+        bst.to_model()
+        preds.append(np.asarray(bst.predict(X, raw_score=True)))
+    np.testing.assert_array_equal(preds[0], preds[1])
+
+
+# ------------------------------------------------------- comm-model gauges
+
+def test_voting_ici_gauge_independent_of_f(rng):
+    """THE voting claim (perfmodel.voting_ici_bytes_per_wave): per-wave
+    ICI volume depends on top_k, never on F. max_bin=16 so both widths
+    saturate the bin budget; F_pad >= 2*top_k at both widths so the
+    election caps identically."""
+    params = {"objective": "binary", "num_leaves": 7, "max_bin": 16,
+              "top_k": 20, "verbosity": -1}
+    gauges, data_gauges = [], []
+    for f in (64, 256):
+        X = rng.randn(600, f)
+        y = (X[:, 0] > 0).astype(float)
+        for sink, cls in ((gauges, VotingDataParallelTreeLearner),
+                          (data_gauges, DeviceDataParallelTreeLearner)):
+            learner = _learner(cls, X, y, params)
+            global_timer.counters.pop("device_ici_bytes_per_wave", None)
+            learner.finalize(learner.train_async(_snapped_gh(rng, 600)))
+            sink.append(global_timer.counters["device_ici_bytes_per_wave"])
+    assert gauges[0] == gauges[1] > 0, gauges
+    # contrast: the full reduction DOES scale with F (4x the features)
+    assert data_gauges[1] == 4 * data_gauges[0], data_gauges
+
+
+def test_voting_ici_at_most_quarter_of_data_at_f256(rng):
+    """Acceptance: at F=256, top_k=20 the voting learner moves <= 1/4 of
+    the data-parallel learner's per-wave ICI bytes."""
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 16,
+              "top_k": 20, "verbosity": -1}
+    n = 600
+    X = rng.randn(n, 256)
+    y = (X[:, 0] > 0).astype(float)
+    gauges = {}
+    for cls in (DeviceDataParallelTreeLearner, VotingDataParallelTreeLearner):
+        learner = _learner(cls, X, y, params)
+        global_timer.counters.pop("device_ici_bytes_per_wave", None)
+        learner.finalize(learner.train_async(_snapped_gh(rng, n)))
+        gauges[cls.__name__] = global_timer.counters[
+            "device_ici_bytes_per_wave"]
+    assert (gauges["VotingDataParallelTreeLearner"]
+            <= gauges["DeviceDataParallelTreeLearner"] / 4), gauges
+
+
+def test_feature_ici_gauge_independent_of_rows(rng):
+    """Feature-parallel moves ONLY the [2K, D, REC] best-record gather:
+    the gauge must not scale with N (and it is the cheapest of the three
+    learners by orders of magnitude)."""
+    params = {"objective": "binary", "num_leaves": 7, "max_bin": 16,
+              "verbosity": -1}
+    gauges = []
+    for n in (600, 2400):
+        X = rng.randn(n, 6)
+        y = (X[:, 0] > 0).astype(float)
+        learner = _learner(DeviceFeatureParallelTreeLearner, X, y, params)
+        global_timer.counters.pop("feature_ici_bytes_per_wave", None)
+        learner.finalize(learner.train_async(_snapped_gh(rng, n)))
+        gauges.append(global_timer.counters["feature_ici_bytes_per_wave"])
+    assert gauges[0] == gauges[1] > 0, gauges
+
+
+def test_voting_overlap_gauge_published(rng):
+    """The double-buffered dispatch hides the smaller-child slice psum
+    behind the larger-child subtraction: half the wave's ICI bytes by
+    construction, published as device_ici_overlap_pct."""
+    n = 600
+    X = rng.randn(n, 6)
+    y = (X[:, 0] > 0).astype(float)
+    learner = _learner(VotingDataParallelTreeLearner, X, y,
+                       {"objective": "binary", "num_leaves": 7,
+                        "verbosity": -1})
+    global_timer.counters.pop("device_ici_overlap_pct", None)
+    learner.finalize(learner.train_async(_snapped_gh(rng, n)))
+    assert global_timer.counters["device_ici_overlap_pct"] == 50
+
+
+# ------------------------------------------------- small-top_k quality pin
+
+def _driver_scores(cls, X, y, params, objective, rounds=5):
+    cfg = Config(params)
+    ds = CoreDataset.from_matrix(X, label=y, config=cfg)
+    bst = GBDT(cfg, ds, create_objective(objective, cfg))
+    bst.tree_learner = cls(cfg, ds)
+    for _ in range(rounds):
+        if bst.train_one_iter():
+            break
+    bst.to_model()
+    return np.asarray(bst.predict(X, raw_score=True))
+
+
+def test_voting_auc_within_1e3_of_exact(rng):
+    n = 2000
+    X = rng.randn(n, 40)
+    y = (X[:, 0] - 0.5 * X[:, 1] + 0.25 * X[:, 2]
+         + rng.randn(n) * 0.3 > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "top_k": 5,
+              "learning_rate": 0.1, "verbosity": -1}
+    exact = _auc(y, _driver_scores(DeviceDataParallelTreeLearner,
+                                   X, y, params, "binary"))
+    voted = _auc(y, _driver_scores(VotingDataParallelTreeLearner,
+                                   X, y, params, "binary"))
+    assert exact > 0.75  # the comparison saw real learning
+    assert abs(exact - voted) < 1e-3, (exact, voted)
+
+
+@pytest.mark.slow
+def test_voting_l2_within_tolerance_of_exact(rng):
+    n = 2000
+    X = rng.randn(n, 40)
+    y = X[:, 0] - 0.5 * X[:, 1] + 0.25 * X[:, 2] + rng.randn(n) * 0.1
+    params = {"objective": "regression", "num_leaves": 15, "top_k": 5,
+              "learning_rate": 0.1, "verbosity": -1}
+    l2 = []
+    for cls in (DeviceDataParallelTreeLearner, VotingDataParallelTreeLearner):
+        score = _driver_scores(cls, X, y, params, "regression")
+        l2.append(float(np.mean((score - y) ** 2)))
+    exact, voted = l2
+    assert voted <= exact * 1.02, l2
+
+
+# --------------------------------------------------- exact-check counting
+
+@pytest.mark.slow
+def test_exact_check_counts_disagreements(rng, monkeypatch):
+    """LGBM_TPU_VOTING_EXACT_CHECK=1 runs the full reduction alongside
+    the election: a deliberately starved top_k=2 at F=40 must record
+    committed splits where the un-nominated global best won, while
+    top_k >= F must record exactly zero."""
+    monkeypatch.setenv("LGBM_TPU_VOTING_EXACT_CHECK", "1")
+    n = 1500
+    X = rng.randn(n, 40)
+    y = (X[:, :8].sum(axis=1) + rng.randn(n) * 2.0 > 0).astype(float)
+    gh = _snapped_gh(rng, n)
+    miss = {}
+    for top_k in (2, 64):
+        learner = _learner(VotingDataParallelTreeLearner, X, y,
+                           {"objective": "binary", "num_leaves": 31,
+                            "min_data_in_leaf": 5, "top_k": top_k,
+                            "verbosity": -1})
+        assert learner._exact_check
+        global_timer.counters.pop("voting_miss_total", None)
+        learner.finalize(learner.train_async(gh))
+        miss[top_k] = int(global_timer.counters["voting_miss_total"])
+    assert miss[64] == 0, miss
+    assert miss[2] > 0, miss
+
+
+# ------------------------------------------ int16 packing satellite bugfix
+
+def test_int16_packing_keyed_off_global_bag_count(rng, monkeypatch):
+    """The satellite bugfix: with a bag that is int16-safe on EVERY
+    shard-local view (each shard holds <= n/8 rows) but unsafe globally,
+    the packing decision must see the psum'd global count — shards
+    disagreeing on the reduction dtype deadlock or garble the wire. Also
+    pins the quantized+bagged regime bit-identical to the single-device
+    learner under the same skewed bag."""
+    import lightgbm_tpu.parallel.learners as learners_mod
+    from lightgbm_tpu.ops.quantize import int16_reduction_safe
+
+    n = 9216  # 8 shards x 1152 rows
+    X = rng.randn(n, 6)
+    y = (X[:, 0] - 0.4 * X[:, 1] > 0).astype(float)
+    bag = np.sort(np.random.RandomState(5).choice(
+        n, 8200, replace=False)).astype(np.int32)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "min_data_in_leaf": 5, "use_quantized_grad": True}
+    bins = Config(params).num_grad_quant_bins
+    # the skew the bug keyed on: every local view fits int16, the global
+    # reduction does not
+    assert (n // 8) * bins < 32000 <= len(bag) * bins
+
+    seen = []
+
+    def spy(count, b):
+        seen.append((count, b))
+        return int16_reduction_safe(count, b)
+
+    monkeypatch.setattr(learners_mod, "int16_reduction_safe", spy)
+    gh = _snapped_gh(rng, n)
+    sharded = _split_log(DeviceDataParallelTreeLearner, X, y, params, gh, bag)
+    assert seen and seen[0] == (len(bag), bins), seen  # GLOBAL, not local
+    _assert_same_log(_split_log(DeviceTreeLearner, X, y, params, gh, bag),
+                     sharded)
+
+
+# ------------------------------------------------ elastic gang + vote_skew
+
+QUANT_VOTING = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                "tree_learner": "voting", "top_k": 64, "device_type": "cpu",
+                "use_quantized_grad": True, "quant_train_renew_leaf": False,
+                "seed": 7}
+
+
+def _force_device_growth(monkeypatch):
+    """The engine factory only picks the device learners on accelerators;
+    route it onto the fake-device mesh the way the TPU path would."""
+    import lightgbm_tpu.parallel.learners as learners_mod
+
+    monkeypatch.setattr(learners_mod, "device_growth_applies",
+                        lambda *a, **k: True)
+
+
+def _data(seed=7, n=1600, f=10):
+    rng = np.random.RandomState(seed)
+    X = rng.standard_normal((n, f)).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] + rng.standard_normal(n) * 0.5 > 0)
+    return X, y.astype(np.float64)
+
+
+@pytest.mark.slow
+def test_voting_gang_kill_surfaces_worker_lost(rng, monkeypatch):
+    """A gang peer hung mid-train under the elastic runtime: the
+    collective watchdog converts the block into a typed WorkerLostError
+    with the last-good iteration — the voting learner rides the same
+    PR 14 contract as the data-parallel learner."""
+    import lightgbm_tpu as lgb
+
+    _force_device_growth(monkeypatch)
+    X, y = _data(n=800)
+    # the device voting learner's first-iteration compile is ~9s on a CPU
+    # host; a deadline inside that window fires the watchdog before the
+    # hang and async-raises the bare (iteration-less) error. 30s clears
+    # the compile with margin while keeping detection bounded
+    elastic.install(timeout_s=30.0)
+    faults.install("worker_hang@0:2")
+    t0 = time.perf_counter()
+    with pytest.raises(WorkerLostError) as ei:
+        train(dict(QUANT_VOTING), lgb.Dataset(X, label=y), num_boost_round=6)
+    assert ei.value.last_good_iteration == 2
+    assert time.perf_counter() - t0 < 120.0
+
+
+@pytest.mark.slow
+def test_voting_shrink_resume_8_4_1_bit_identical(rng, tmp_path, monkeypatch):
+    """Shrink-to-fit for a voting gang: a quantized run checkpointed on
+    the 8-device mesh, resumed on 4, then on 1, re-shards the vote each
+    leg (top_k >= F keeps the election exact, so the integer reduction
+    stays mesh-independent) and must match the undisturbed 8-device model
+    text byte for byte."""
+    import jax
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.checkpoint import checkpoint_callback
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    _force_device_growth(monkeypatch)
+    X, y = _data(seed=42)
+    ck = str(tmp_path / "chain.txt")
+
+    undisturbed = train(dict(QUANT_VOTING), lgb.Dataset(X, label=y),
+                        num_boost_round=6)
+
+    def leg(boost_to, devices, resume):
+        if devices:
+            monkeypatch.setenv("LGBM_TPU_FORCE_MESH_DEVICES", str(devices))
+        else:
+            monkeypatch.delenv("LGBM_TPU_FORCE_MESH_DEVICES", raising=False)
+        bst = train(dict(QUANT_VOTING), lgb.Dataset(X, label=y),
+                    num_boost_round=boost_to,
+                    init_model=ck if resume else None,
+                    callbacks=[checkpoint_callback(ck, period=2)])
+        monkeypatch.delenv("LGBM_TPU_FORCE_MESH_DEVICES", raising=False)
+        return bst
+
+    leg(2, devices=0, resume=False)
+    leg(4, devices=4, resume=True)
+    chained = leg(6, devices=1, resume=True)
+    assert (chained.model_to_string(num_iteration=-1)
+            == undisturbed.model_to_string(num_iteration=-1))
+
+
+def test_vote_skew_exact_check_raises_typed_error(rng, monkeypatch):
+    """faults token vote_skew@R:K + exact check: a corrupted ballot must
+    abort with VotingDivergenceError naming the injection — never train
+    on silently."""
+    monkeypatch.setenv("LGBM_TPU_VOTING_EXACT_CHECK", "1")
+    faults.install("vote_skew@2:1")
+    n = 1100
+    X = rng.randn(n, 20)
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(float)
+    learner = _learner(VotingDataParallelTreeLearner, X, y,
+                       {"objective": "binary", "num_leaves": 15,
+                        "min_data_in_leaf": 5, "top_k": 3,
+                        "verbosity": -1})
+    with pytest.raises(VotingDivergenceError, match="vote_skew@2:1"):
+        learner.finalize(learner.train_async(_snapped_gh(rng, n)))
+
+
+def test_vote_skew_elastic_surfaces_worker_lost(rng, monkeypatch):
+    """Without the exact check, under an elastic gang, the detecting
+    worker parks in the interruptible watchdog spin and the deadline
+    converts the injection into WorkerLostError — a typed error, not a
+    hang."""
+    monkeypatch.delenv("LGBM_TPU_VOTING_EXACT_CHECK", raising=False)
+    n = 1100
+    X = rng.randn(n, 20)
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "top_k": 3,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    cfg = Config(params)
+    ds = CoreDataset.from_matrix(X, label=y, config=cfg)
+    bst = GBDT(cfg, ds, create_objective("binary", cfg))
+    bst.tree_learner = VotingDataParallelTreeLearner(cfg, ds)
+    elastic.install(timeout_s=1.0)
+    faults.install("vote_skew@1:0")
+    t0 = time.perf_counter()
+    with pytest.raises(WorkerLostError):
+        for _ in range(3):
+            bst.train_one_iter()
+    assert time.perf_counter() - t0 < 60.0
