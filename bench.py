@@ -433,6 +433,8 @@ def run_bench(n_rows: int) -> dict:
         import threading
         import urllib.request
 
+        import numpy as np
+
         from lightgbm_tpu import tracing
         from lightgbm_tpu.serving import PredictionService
         from lightgbm_tpu.serving.http import serve as serve_http
@@ -440,7 +442,11 @@ def run_bench(n_rows: int) -> dict:
         serve_rows = 64
         serve_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", 300))
         tracing.reset_stats()  # this section owns the stage quantiles
-        svc = PredictionService(max_batch_rows=4096, batch_window_s=0.001)
+        # min_bucket matches the 64-row request size: the coalescing beat
+        # only helps when a batch is still below one bucket, and a 256-row
+        # floor made every ~3-request batch pay the full window
+        svc = PredictionService(max_batch_rows=4096, min_bucket=64,
+                                batch_window_s=0.001)
         server = None
         try:
             svc.load_model("bench", booster=bst)
@@ -489,10 +495,154 @@ def run_bench(n_rows: int) -> dict:
                                  ("serialize", "serve_serialize_ms_p99")):
                 out[field] = round(
                     stages.get(stage, {}).get("p99_ms", 0.0), 3)
+
+            # binary wire format (serving/wire.py): the SAME rows as raw
+            # f32 frames, zero-copy decoded server-side. Open-loop like
+            # the JSON drive above: each persistent connection pipelines
+            # its requests (send all frames, then drain the responses) so
+            # the wire cost — not per-round-trip latency — is what's
+            # measured; the JSON scenario is untouched for cross-PR
+            # comparability
+            import socket
+
+            from lightgbm_tpu.serving import wire as wire_mod
+
+            wire_workers = 16
+            per_worker = max(1, serve_requests // wire_workers)
+
+            def _wire_http(frame):
+                return (b"POST /predict HTTP/1.1\r\nHost: bench\r\n"
+                        b"Content-Type: " + wire_mod.CONTENT_TYPE.encode()
+                        + b"\r\nContent-Length: " + str(len(frame)).encode()
+                        + b"\r\n\r\n" + frame)
+
+            frames = [_wire_http(wire_mod.encode_request(
+                "bench",
+                np.ascontiguousarray(
+                    X[(i * serve_rows) % span:
+                      (i * serve_rows) % span + serve_rows],
+                    dtype=np.float32),
+                raw_score=True)) for i in range(wire_workers)]
+            wire_rows = [0] * wire_workers
+
+            def fire_wire(w):
+                sock = socket.create_connection(
+                    ("127.0.0.1", server.port), timeout=60)
+                sock.setsockopt(  # no Nagle stall between frames
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                payload = frames[w] * per_worker
+                sender = threading.Thread(
+                    target=lambda: sock.sendall(payload))
+                sender.start()
+                fh = sock.makefile("rb")
+                try:
+                    for _ in range(per_worker):
+                        status = fh.readline()
+                        clen = 0
+                        while True:
+                            line = fh.readline()
+                            if not line or line == b"\r\n":
+                                break
+                            if line.lower().startswith(b"content-length:"):
+                                clen = int(line.split(b":")[1])
+                        fh.read(clen)
+                        if b" 200 " in status:
+                            wire_rows[w] += serve_rows
+                finally:
+                    sender.join()
+                    fh.close()
+                    sock.close()
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=fire_wire, args=(w,))
+                       for w in range(wire_workers)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            wire_s = time.perf_counter() - t0
+            out["serve_wire_binary_rows_per_sec"] = round(
+                sum(wire_rows) / wire_s, 1)
         finally:
             if server is not None:
                 server.shutdown()
             svc.close()
+
+        # replica cold start: persist the model + its AOT executable
+        # bundle, drop every compile cache (a fresh process stand-in), and
+        # time load -> first bucket-shaped answer, with and without the
+        # bundle — the serve_cold_start_ms vs *_compile_ms gap is what the
+        # warm-start tentpole buys a scale-out event
+        import tempfile as _tmp
+
+        import jax as _jax
+
+        from lightgbm_tpu.checkpoint import save_checkpoint as _save_ckpt
+
+        with _tmp.TemporaryDirectory() as td:
+            mpath = os.path.join(td, "bench_model.txt")
+            _save_ckpt(bst, mpath)
+            svc_w = PredictionService(max_batch_rows=1024,
+                                      batch_window_s=0.0)
+            try:
+                svc_w.load_model("warm", path=mpath)
+                svc_w.export_aot("warm")
+            finally:
+                svc_w.close()
+            probe = np.ascontiguousarray(X[:256], dtype=np.float32)
+
+            def _cold_ms(drop_aot):
+                if drop_aot:
+                    os.remove(mpath + ".aot")
+                _jax.clear_caches()
+                svc_c = PredictionService(max_batch_rows=1024,
+                                          batch_window_s=0.0)
+                try:
+                    t0 = time.perf_counter()
+                    svc_c.load_model("cold", path=mpath)
+                    svc_c.predict("cold", probe, raw_score=True)
+                    return (time.perf_counter() - t0) * 1e3
+                finally:
+                    svc_c.close()
+
+            out["serve_cold_start_ms"] = round(_cold_ms(False), 1)
+            out["serve_cold_start_compile_ms"] = round(_cold_ms(True), 1)
+
+        # fleet dispatch: throughput of one hot model on one replica vs
+        # two hot models pinned to two replicas, closed-loop in-process
+        # callers — perfect scaling is 1.0, contention shows below it
+        from lightgbm_tpu import perfmodel as _perfmodel
+
+        def _fleet_rows_per_sec(n_entries, replicas):
+            svc_f = PredictionService(max_batch_rows=4096,
+                                      batch_window_s=0.0,
+                                      replicas=replicas)
+            block = np.ascontiguousarray(X[:serve_rows], dtype=np.float32)
+            reqs = max(50, serve_requests // 2)
+            try:
+                for i in range(n_entries):
+                    svc_f.load_model(f"rep{i}", booster=bst)
+
+                def drive(name):
+                    for _ in range(reqs):
+                        svc_f.predict(name, block, raw_score=True)
+
+                drivers = [threading.Thread(target=drive, args=(f"rep{i}",))
+                           for i in range(n_entries) for _ in range(2)]
+                t0 = time.perf_counter()
+                for th in drivers:
+                    th.start()
+                for th in drivers:
+                    th.join()
+                dt = time.perf_counter() - t0
+                return 2 * n_entries * reqs * serve_rows / dt
+            finally:
+                svc_f.close()
+
+        fleet_t1 = _fleet_rows_per_sec(1, 1)
+        fleet_t2 = _fleet_rows_per_sec(2, 2)
+        out["serve_replica_scaling_efficiency"] = \
+            _perfmodel.serve_replica_scaling_efficiency(fleet_t1, fleet_t2, 2)
 
         # robustness-layer cost: one full-state checkpoint write of the
         # trained model (model text + sidecar, atomic + fsync) ...
@@ -754,7 +904,11 @@ def main() -> None:
                       "serve_batches", "serve_parse_ms_p99",
                       "serve_queue_ms_p99", "serve_assembly_ms_p99",
                       "serve_device_ms_p99", "serve_d2h_ms_p99",
-                      "serve_serialize_ms_p99", "stream_ingest_rows_per_sec",
+                      "serve_serialize_ms_p99",
+                      "serve_wire_binary_rows_per_sec",
+                      "serve_cold_start_ms", "serve_cold_start_compile_ms",
+                      "serve_replica_scaling_efficiency",
+                      "stream_ingest_rows_per_sec",
                       "stream_train_rows_per_sec", "hbm_resident_fraction",
                       "stream_h2d_overlap_pct", "drift_check_overhead_pct",
                       "bin_refresh_ms", "gate_eval_ms", "stream_error",

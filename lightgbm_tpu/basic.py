@@ -577,6 +577,12 @@ class Booster:
         chunk_kw = kwargs.get("pred_chunk_rows",
                               self.params.get("pred_chunk_rows"))
         chunk_rows = int(chunk_kw) if chunk_kw is not None else None
+        # pred_shard_rows: row-shard this predict across the data mesh once
+        # the batch reaches the given row count (parallel/predict.py policy;
+        # inert on single-device platforms)
+        shard_kw = kwargs.get("pred_shard_rows",
+                              self.params.get("pred_shard_rows"))
+        shard_rows = int(shard_kw) if shard_kw is not None else None
         if param_bool(kwargs.get("pred_early_stop",
                                  self.params.get("pred_early_stop"))):
             return self._gbdt.predict(
@@ -591,7 +597,8 @@ class Booster:
         return self._gbdt.predict(X, raw_score=raw_score,
                                   num_iteration=num_iteration,
                                   start_iteration=start_iteration,
-                                  chunk_rows=chunk_rows)
+                                  chunk_rows=chunk_rows,
+                                  shard_rows=shard_rows)
 
     # ------------------------------------------------------------------ model
 

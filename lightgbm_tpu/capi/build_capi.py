@@ -74,6 +74,14 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                               int start_iteration, int num_iteration,
                               const char* parameter, int64_t* out_len,
                               double* out_result);
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result);
 int LGBM_BoosterFree(BoosterHandle handle);
 """
 
@@ -151,6 +159,7 @@ _bind("LGBM_BoosterGetCurrentIteration", "booster_get_current_iteration")
 _bind("LGBM_BoosterGetNumClasses", "booster_get_num_classes")
 _bind("LGBM_BoosterNumberOfTotalModel", "booster_number_of_total_model")
 _bind("LGBM_BoosterPredictForMat", "booster_predict_for_mat")
+_bind("LGBM_BoosterPredictForCSR", "booster_predict_for_csr")
 _bind("LGBM_BoosterFree", "booster_free")
 """
 

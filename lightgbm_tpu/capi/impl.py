@@ -322,6 +322,49 @@ def booster_predict_for_mat(ffi, handle, data, data_type, nrow, ncol,
     return 0
 
 
+def booster_predict_for_csr(ffi, handle, indptr, indptr_type, indices, data,
+                            data_type, nindptr, nelem, num_col,
+                            predict_type, start_iteration, num_iteration,
+                            parameter, out_len, out_result):
+    bst = _get(handle)
+    ip_dt = _DTYPES.get(int(indptr_type))
+    if ip_dt not in (np.int32, np.int64):
+        raise ValueError(f"indptr_type must be int32/int64, got {indptr_type}")
+    ip_buf = ffi.buffer(indptr, int(nindptr) * np.dtype(ip_dt).itemsize)
+    ip = np.frombuffer(ip_buf, dtype=ip_dt).copy()
+    idx_buf = ffi.buffer(indices, int(nelem) * np.dtype(np.int32).itemsize)
+    idx = np.frombuffer(idx_buf, dtype=np.int32).copy()
+    dt = _DTYPES.get(int(data_type))
+    if dt is None:
+        raise ValueError(f"unknown C_API_DTYPE {data_type}")
+    val_buf = ffi.buffer(data, int(nelem) * np.dtype(dt).itemsize)
+    values = np.frombuffer(val_buf, dtype=dt).copy()
+    nrow = int(nindptr) - 1
+    # densify: absent CSR entries are 0.0 (the reference's default
+    # zero-elimination contract; zero_as_missing remaps them later in the
+    # bin mapper, not here), then route onto the same Booster.predict the
+    # ForMat entry uses so both surfaces answer bit-identically
+    X = np.zeros((nrow, int(num_col)), dtype=np.float64)
+    for r in range(nrow):
+        lo, hi = int(ip[r]), int(ip[r + 1])
+        X[r, idx[lo:hi]] = values[lo:hi]
+    pt = int(predict_type)
+    extra = _parse_params(ffi.string(parameter).decode())
+    pred = bst.predict(
+        X,
+        raw_score=(pt == 1),
+        pred_leaf=(pt == 2),
+        pred_contrib=(pt == 3),
+        start_iteration=int(start_iteration),
+        num_iteration=int(num_iteration),
+        **extra,
+    )
+    flat = np.ascontiguousarray(pred, dtype=np.float64).ravel()
+    out_len[0] = flat.size
+    ffi.buffer(out_result, flat.size * 8)[:] = flat.tobytes()
+    return 0
+
+
 def booster_free(ffi, handle):
     _free(handle)
     return 0

@@ -48,6 +48,8 @@ from .utils.timer import global_timer
 CKPT_MAGIC = b"LGBMCKPT"
 CKPT_VERSION = 1
 SIDECAR_SUFFIX = ".ckpt"
+AOT_MAGIC = b"LGBMAOT1"
+AOT_SUFFIX = ".aot"
 _BACKOFF_S = 0.05  # doubled per retry attempt
 
 
@@ -257,6 +259,35 @@ def read_sidecar_manifest(path: str) -> Optional[Dict[str, Any]]:
         return None
     manifest, _ = _load_sidecar_payload(sidecar)
     return manifest
+
+
+def write_aot_sidecar(path: str, bundle: bytes, retries: int = 3) -> str:
+    """Persist a compiled-executable bundle next to the model at `path`
+    as ``path + '.aot'`` (magic + payload sha256 + payload, same framing
+    as the checkpoint sidecar). Returns the sidecar path."""
+    blob = AOT_MAGIC + hashlib.sha256(bundle).digest() + bundle
+    sidecar = path + AOT_SUFFIX
+    atomic_write_bytes(sidecar, blob, retries=retries)
+    return sidecar
+
+
+def read_aot_sidecar(path: str) -> Optional[bytes]:
+    """The validated AOT bundle bytes for the model at `path`, or None
+    when no ``.aot`` sidecar exists. A sidecar that exists but is damaged
+    (bad magic / checksum mismatch) raises CheckpointError: the loader
+    must fall back to fresh compiles, never deserialize torn bytes."""
+    sidecar = path + AOT_SUFFIX
+    if not os.path.exists(sidecar):
+        return None
+    with open(sidecar, "rb") as fh:
+        blob = fh.read()
+    if blob[:len(AOT_MAGIC)] != AOT_MAGIC:
+        raise CheckpointError("bad AOT sidecar magic")
+    digest = blob[len(AOT_MAGIC):len(AOT_MAGIC) + 32]
+    payload = blob[len(AOT_MAGIC) + 32:]
+    if hashlib.sha256(payload).digest() != digest:
+        raise CheckpointError("AOT sidecar checksum mismatch")
+    return payload
 
 
 def load_checkpoint(path: str) -> Optional[TrainerState]:

@@ -590,10 +590,11 @@ class GBDT:
     # ---------------------------------------------------------------- predict
 
     @staticmethod
-    def _sharded_predict_enabled(n_rows: int) -> bool:
+    def _sharded_predict_enabled(n_rows: int,
+                                 min_rows: Optional[int] = None) -> bool:
         from ..parallel.predict import sharded_predict_enabled
 
-        return sharded_predict_enabled(n_rows)
+        return sharded_predict_enabled(n_rows, min_rows=min_rows)
 
     def _packed(self, num_iteration: int = 0, start_iteration: int = 0,
                 dtype=jnp.float32):
@@ -608,7 +609,8 @@ class GBDT:
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 num_iteration: int = 0, start_iteration: int = 0,
                 early_stop: Optional[Tuple[int, float]] = None,
-                chunk_rows: Optional[int] = None) -> np.ndarray:
+                chunk_rows: Optional[int] = None,
+                shard_rows: Optional[int] = None) -> np.ndarray:
         dtype = predict_dtype(X)
         packed = self._packed(num_iteration, start_iteration, dtype=dtype)
         C = self.num_tree_per_iteration
@@ -625,7 +627,7 @@ class GBDT:
             out = predict_raw_streamed(
                 packed, np.asarray(X, dtype=np.dtype(dtype)), C, chunk, dtype)
         elif packed.num_trees > 0 and not packed.linear \
-                and self._sharded_predict_enabled(n):
+                and self._sharded_predict_enabled(n, shard_rows):
             # linear ensembles keep single-chip dispatch: their score math
             # runs eagerly for bit-stability (ops/predict.predict_raw)
             from ..parallel.predict import predict_raw_sharded
@@ -636,7 +638,21 @@ class GBDT:
             out = predict_raw_streamed(
                 packed, np.asarray(X, dtype=np.dtype(dtype)), C, chunk, dtype)
         else:
-            out = predict_raw(packed, jnp.asarray(X, dtype=dtype), C)
+            # serving warm start: a key-matched AOT executable answers
+            # without consulting (or populating) the jit cache — a cold
+            # replica's first bucket-shaped request skips the XLA compile
+            fn = None
+            if packed.num_trees > 0 and not packed.linear:
+                from ..ops.predict import predict_pallas_enabled
+
+                if not predict_pallas_enabled():
+                    fn = self._predictor.aot_get(
+                        packed, n, X.shape[1], C, np.dtype(dtype))
+            if fn is not None:
+                with global_timer.scope("predict_traverse"):
+                    out = fn(packed, jnp.asarray(X, dtype=dtype))
+            else:
+                out = predict_raw(packed, jnp.asarray(X, dtype=dtype), C)
         if self.average_output and packed.num_trees > 0:
             out = out / (packed.num_trees // C)
         if not raw_score and self.objective is not None:

@@ -371,6 +371,148 @@ def predict_raw(packed: PackedEnsemble, X: jax.Array,
         return _predict_raw_fused(packed, X, num_tree_per_iteration)
 
 
+# --------------------------------------------------------------------- aot
+#
+# Ahead-of-time compiled predict executables for serving warm start.
+# A warm writer lowers + compiles the fused traversal for each micro-batch
+# bucket shape, serializes the executables (jax.experimental.
+# serialize_executable), and the bundle persists next to the model
+# checkpoint (checkpoint.write_aot_sidecar). A cold replica deserializes
+# in milliseconds instead of paying one XLA compile per bucket before its
+# first answer. Safety: an executable is specialized on SHAPES only — the
+# packed ensemble is a runtime argument — so a loaded executable can never
+# produce a wrong answer for a key-matched call; staleness is an
+# ENVIRONMENT property (jax/jaxlib build, backend, device kind), checked
+# against the bundle's fingerprint at load, and any mismatch falls back
+# to a fresh compile with a warning.
+
+AOT_FORMAT_VERSION = 1
+
+
+def aot_environment() -> dict:
+    """The environment fingerprint an AOT bundle is valid for. XLA
+    executables are build- and target-specific: every field here must
+    match between writer and loader or deserialization is refused."""
+    import jaxlib
+
+    try:
+        dev = jax.devices()[0]
+        kind, platform = str(dev.device_kind), str(dev.platform)
+    except Exception:  # noqa: BLE001 - no backend: still fingerprintable
+        kind, platform = "", ""
+    return {
+        "format": AOT_FORMAT_VERSION,
+        "jax": jax.__version__,
+        "jaxlib": getattr(jaxlib.version, "__version__", ""),
+        "backend": jax.default_backend(),
+        "platform": platform,
+        "device_kind": kind,
+    }
+
+
+def aot_call_key(packed: PackedEnsemble, n_rows: int, n_cols: int,
+                 num_tree_per_iteration: int, x_dtype) -> tuple:
+    """Exact dispatch key: every packed leaf's (shape, dtype) plus the
+    input block shape/dtype and the static tree grouping. Matching this
+    key guarantees the executable's input avals match the call."""
+    leaves = jax.tree_util.tree_leaves(packed)
+    return (tuple((tuple(int(s) for s in leaf.shape), str(leaf.dtype))
+                  for leaf in leaves),
+            (int(n_rows), int(n_cols)), np.dtype(x_dtype).name,
+            int(num_tree_per_iteration))
+
+
+def aot_compile(packed: PackedEnsemble, n_rows: int, n_cols: int,
+                num_tree_per_iteration: int, x_dtype=np.float32):
+    """Lower + compile the fused traversal for one bucket shape without
+    touching (or populating) the jit dispatch cache."""
+    xs = jax.ShapeDtypeStruct((int(n_rows), int(n_cols)),
+                              np.dtype(x_dtype))
+    return _predict_raw_fused.lower(
+        packed, xs, num_tree_per_iteration=num_tree_per_iteration).compile()
+
+
+def aot_serialize_bundle(packed: PackedEnsemble, n_cols: int,
+                         num_tree_per_iteration: int,
+                         buckets: Sequence[int], x_dtype=np.float32,
+                         model_sha256: str = "") -> bytes:
+    """Compile and serialize one executable per bucket row count into a
+    self-describing bundle (environment fingerprint + model hash +
+    keyed payloads). Linear packs are refused: their score math runs
+    eagerly for bit-stability (see predict_raw), so there is no single
+    executable to persist."""
+    import pickle
+
+    from jax.experimental.serialize_executable import serialize
+
+    if packed.linear:
+        raise ValueError("AOT bundles cover the fused traversal only; "
+                         "linear-tree ensembles keep eager score math")
+    entries = []
+    with global_timer.scope("predict_aot_export"):
+        for rows in buckets:
+            compiled = aot_compile(packed, rows, n_cols,
+                                   num_tree_per_iteration, x_dtype)
+            payload, in_tree, out_tree = serialize(compiled)
+            entries.append({
+                "key": aot_call_key(packed, rows, n_cols,
+                                    num_tree_per_iteration, x_dtype),
+                "rows": int(rows),
+                "payload": payload,
+                "in_tree": in_tree,
+                "out_tree": out_tree,
+            })
+    return pickle.dumps({
+        "environment": aot_environment(),
+        "model_sha256": model_sha256,
+        "entries": entries,
+    }, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def aot_load_bundle(blob: bytes, model_sha256: Optional[str] = None):
+    """Deserialize a bundle into {call_key: loaded_executable}.
+
+    Returns (executables, problems). A non-empty `problems` list means the
+    bundle was REFUSED (environment fingerprint mismatch, wrong model
+    hash, damaged payload) and the mapping is empty — the caller logs the
+    reasons and falls back to fresh compilation; a stale bundle can cost a
+    compile, never a wrong answer."""
+    import pickle
+
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    problems: List[str] = []
+    try:
+        obj = pickle.loads(blob)
+    except Exception as exc:  # noqa: BLE001 - any damage -> refuse
+        return {}, [f"undecodable AOT bundle: {exc!r}"]
+    env, want = aot_environment(), obj.get("environment")
+    if want != env:
+        diff = sorted(k for k in set(env) | set(want or {})
+                      if (want or {}).get(k) != env.get(k))
+        problems.append(
+            "environment fingerprint mismatch on "
+            + ", ".join(f"{k}: bundle {((want or {}).get(k))!r} != "
+                        f"here {env.get(k)!r}" for k in diff))
+    if model_sha256 and obj.get("model_sha256") \
+            and obj["model_sha256"] != model_sha256:
+        problems.append(
+            f"bundle was exported for model sha "
+            f"{str(obj['model_sha256'])[:12]}.., loading {model_sha256[:12]}..")
+    if problems:
+        return {}, problems
+    out = {}
+    with global_timer.scope("predict_aot_load"):
+        for ent in obj.get("entries", ()):
+            try:
+                out[ent["key"]] = deserialize_and_load(
+                    ent["payload"], ent["in_tree"], ent["out_tree"])
+            except Exception as exc:  # noqa: BLE001 - refuse the bundle
+                return {}, [f"executable for {ent.get('rows')} rows failed "
+                            f"to deserialize: {exc!r}"]
+    return out, []
+
+
 # ------------------------------------------------------------------- cache
 
 
@@ -399,12 +541,47 @@ class PredictorCache:
         self.capacity = capacity
         self._version = 0
         self._entries: "OrderedDict[tuple, PackedEnsemble]" = OrderedDict()
+        # AOT warm-start executables (aot_load_bundle), keyed by the exact
+        # aot_call_key. Shape-specialized, value-free: any key-matched call
+        # is correct by construction. Dropped on invalidate with the packs
+        # — a mutated model changes pack shapes, so stale keys would only
+        # miss, but holding dead executables pins memory for nothing.
+        self._aot: dict = {}
         self._lock = threading.Lock()
 
     def invalidate(self) -> None:
         with self._lock:
             self._version += 1
             self._entries.clear()
+            self._aot.clear()
+
+    # ------------------------------------------------------------- aot
+
+    def install_aot(self, executables: dict) -> int:
+        """Install {aot_call_key: loaded_executable} (serving warm start).
+        Returns the number now installed."""
+        with self._lock:
+            self._aot.update(executables)
+            return len(self._aot)
+
+    def aot_get(self, packed: PackedEnsemble, n_rows: int, n_cols: int,
+                num_tree_per_iteration: int, x_dtype):
+        """The installed executable exactly matching this dispatch, or
+        None (caller falls through to the jit path)."""
+        if not self._aot:
+            return None
+        key = aot_call_key(packed, n_rows, n_cols,
+                           num_tree_per_iteration, x_dtype)
+        with self._lock:
+            fn = self._aot.get(key)
+        if fn is not None:
+            global_timer.add_count("predict_aot_hits", 1)
+        return fn
+
+    def aot_rows(self) -> List[int]:
+        """Row counts (bucket sizes) with an installed executable."""
+        with self._lock:
+            return sorted({key[1][0] for key in self._aot})
 
     def get(self, trees: Sequence[Tree], start: int, end: int,
             dtype=jnp.float32) -> PackedEnsemble:

@@ -34,9 +34,13 @@ _AUTO_MIN_ROWS = 1 << 16  # below this, single-chip dispatch is cheaper
 _fn_cache: dict = {}
 
 
-def sharded_predict_enabled(n_rows: int) -> bool:
+def sharded_predict_enabled(n_rows: int,
+                            min_rows: Optional[int] = None) -> bool:
     """Row-sharding policy: env force-off/on, else auto for large batches
-    on multi-device platforms."""
+    on multi-device platforms. `min_rows` (the pred_shard_rows param —
+    the serving fleet sets it per model entry) replaces the auto
+    threshold: batches at or above it shard, smaller ones stay
+    single-chip."""
     env = os.environ.get(_SHARD_ENV, "").lower()
     if env in ("0", "false", "off"):
         return False
@@ -44,7 +48,7 @@ def sharded_predict_enabled(n_rows: int) -> bool:
         return False
     if env in ("1", "true", "on"):
         return True
-    return n_rows >= _AUTO_MIN_ROWS
+    return n_rows >= (_AUTO_MIN_ROWS if min_rows is None else max(1, min_rows))
 
 
 def _sharded_predict_fn(mesh: jax.sharding.Mesh, num_tree_per_iteration: int):

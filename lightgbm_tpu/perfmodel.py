@@ -134,6 +134,56 @@ def feature_ici_bytes_per_wave(wave_width: int, n_shards: int) -> int:
     return 2 * int(wave_width) * int(n_shards) * REC_FIELDS * 4
 
 
+def serve_wire_bytes_per_request(n_rows: int, n_cols: int,
+                                 binary: bool = True,
+                                 name_len: int = 8,
+                                 json_chars_per_value: int = 20) -> int:
+    """Request-body bytes on the serving wire (PERF_NOTES round-10).
+
+    Binary (serving/wire.py): a fixed 24-byte header + the model name +
+    the raw f32 row block — 4 bytes per value, parsed by one zero-copy
+    frombuffer. JSON: each f64 value prints as up to ~20 characters
+    (sign, 17 significant digits, exponent, comma), so the same rows cost
+    ~5x the bytes AND a per-value float parse. The ratio is the static
+    half of the measured serve_wire_binary_rows_per_sec /
+    serve_rows_per_sec speedup; the dynamic half is the per-request
+    allocation count (one view vs a parsed list-of-lists)."""
+    if binary:
+        return 24 + int(name_len) + 4 * int(n_rows) * int(n_cols)
+    # {"model": ..., "rows": [[...]]} framing plus per-value text
+    return (24 + int(name_len)
+            + int(n_rows) * int(n_cols) * int(json_chars_per_value)
+            + 2 * int(n_rows))
+
+
+def serve_cold_start_ms(n_buckets: int, compile_ms_per_bucket: float,
+                        deserialize_ms_per_bucket: float = 7.0,
+                        aot: bool = True) -> float:
+    """Replica cold-start model (PERF_NOTES round-10): time from model
+    load to the first bucket-shaped answer. Without an AOT bundle every
+    warmup bucket pays one XLA compile (O(100ms) each, serialized on the
+    main thread); with one (ops/predict.aot_serialize_bundle persisted by
+    checkpoint.write_aot_sidecar) each bucket pays only executable
+    deserialization, measured at ~7ms on CPU — a ~25x per-bucket ratio
+    that the serve_cold_start_ms ledger metric tracks end to end."""
+    per = (float(deserialize_ms_per_bucket) if aot
+           else float(compile_ms_per_bucket))
+    return float(n_buckets) * per
+
+
+def serve_replica_scaling_efficiency(t1_rows_per_sec: float,
+                                     tn_rows_per_sec: float,
+                                     n_replicas: int) -> float:
+    """Fleet dispatch efficiency: measured N-replica throughput over N x
+    the single-replica figure. Below 1.0 the replicas are contending (one
+    device queue, GIL-held decode, shared breaker lock); the ledger metric
+    of the same name records the 2-replica figure on the smoke bench."""
+    if t1_rows_per_sec <= 0 or n_replicas <= 0:
+        return 0.0
+    return round(float(tn_rows_per_sec)
+                 / (float(n_replicas) * float(t1_rows_per_sec)), 4)
+
+
 def ici_overlap_pct(overlapped_bytes: int, total_bytes: int) -> float:
     """Share of a wave's ICI traffic dispatched while independent local
     compute is still pending (double-buffered dispatch, PERF_NOTES

@@ -231,10 +231,18 @@ class MicroBatcher:
                 tracing.dump_flight("batcher_exception")
 
     def _pad(self, chunk: np.ndarray, cap: int) -> np.ndarray:
-        """Pad to the power-of-two bucket the jit cache already holds."""
+        """Pad to the power-of-two bucket the jit cache already holds.
+
+        Exact bucket fit is ZERO-COPY: a float32 C-contiguous chunk whose
+        row count already equals its bucket (a full max-size chunk, or a
+        request sized to a warmed bucket — the binary wire path decodes
+        straight into such views) is dispatched as-is. Only a ragged tail
+        pays the pad allocation."""
         n = chunk.shape[0]
         target = min(bucket_size(n, min(self.min_bucket, cap)), cap)
         if target <= n:
+            if chunk.dtype == np.float32 and chunk.flags["C_CONTIGUOUS"]:
+                return chunk
             return np.ascontiguousarray(chunk, dtype=np.float32)
         padded = np.zeros((target, chunk.shape[1]), dtype=np.float32)
         padded[:n] = chunk
@@ -249,15 +257,15 @@ class MicroBatcher:
         stages["assembly"] += t_dev - t
         if decision.use_host:
             out = entry.predict_host(padded, raw_score)
-            self.breaker.on_success(was_host=True)
+            self.breaker.on_success(was_host=True, entry=entry.name)
             self.n_host_chunks += 1
         else:
             try:
                 faults.on_serve_dispatch()
                 out = entry.predict_device(padded, raw_score)
-                self.breaker.on_success()
+                self.breaker.on_success(entry=entry.name)
             except Exception as exc:
-                self.breaker.on_failure(exc)
+                self.breaker.on_failure(exc, entry=entry.name)
                 self.n_device_failures += 1
                 global_timer.add_count("serve_dispatch_failures", 1)
                 Log.warning("serving: device dispatch failed (%s); "
@@ -292,7 +300,9 @@ class MicroBatcher:
              else np.concatenate([r.rows for r in batch], axis=0))
         stages["assembly"] += time.perf_counter() - t_asm
         n = int(X.shape[0])
-        decision = self.breaker.decide()
+        # per-entry breaker shard: one tenant's faulting model sheds ITS
+        # load to the host path without opening the breaker for the fleet
+        decision = self.breaker.decide(entry.name)
         cap = self.max_batch_rows
         if decision.max_rows is not None:
             cap = min(cap, bucket_size(max(1, decision.max_rows), 1))

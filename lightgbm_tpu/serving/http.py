@@ -6,6 +6,11 @@ costs idle waiters, not device contention — every dispatch still funnels
 through the batcher's single worker. No framework, no new dependency.
 
     POST   /predict          {"model", "rows", "raw_score"?, "timeout_ms"?}
+                             Content-Type negotiated: application/json (the
+                             compatibility path, bit-identical to before) or
+                             application/x-lgbm-wire (serving/wire.py binary
+                             framing — zero-copy numpy decode, raw float32
+                             response block)
     GET    /models           registered models + versions
     POST   /models           {"name", "path"|"model_str", "expected_sha256"?,
                               "reject_nonfinite"?}  -> staged verified swap
@@ -38,6 +43,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from .. import tracing
 from ..utils.log import Log
+from . import wire
 from .errors import InvalidRequest, Overloaded, ServingError
 from .service import PredictionService
 
@@ -47,6 +53,10 @@ MAX_BODY_BYTES = 64 * 1024 * 1024
 class ServingHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
+    # socketserver's default listen backlog is 5: a fleet of clients
+    # connecting at once gets connection RESETS, not queueing. Size the
+    # backlog for a connection storm instead.
+    request_queue_size = 128
 
     def __init__(self, service: PredictionService, host: str = "127.0.0.1",
                  port: int = 0) -> None:
@@ -60,6 +70,10 @@ class ServingHTTPServer(ThreadingHTTPServer):
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # Nagle + delayed-ACK interact badly with the header/body write pair
+    # on keep-alive connections: a closed-loop client sees ~40 ms stalls
+    # per response. Predictions are latency-sensitive; flush immediately.
+    disable_nagle_algorithm = True
 
     # BaseHTTPRequestHandler logs every request to stderr by default;
     # route through the package logger at debug level instead
@@ -101,7 +115,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(500, {"error": "internal_error",
                                   "detail": str(exc)})
 
-    def _read_json(self) -> Dict[str, Any]:
+    def _send_wire(self, status: int, body: bytes,
+                   headers: Optional[Dict[str, str]] = None) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", wire.CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
         length = int(self.headers.get("Content-Length") or 0)
         if length <= 0:
             raise InvalidRequest("missing request body")
@@ -109,8 +133,11 @@ class _Handler(BaseHTTPRequestHandler):
             raise InvalidRequest(
                 f"request body of {length} bytes exceeds the "
                 f"{MAX_BODY_BYTES}-byte limit")
+        return self.rfile.read(length)
+
+    def _read_json(self) -> Dict[str, Any]:
         try:
-            payload = json.loads(self.rfile.read(length))
+            payload = json.loads(self._read_body())
         except (ValueError, UnicodeDecodeError) as exc:
             raise InvalidRequest(f"body is not valid JSON: {exc}")
         if not isinstance(payload, dict):
@@ -171,6 +198,43 @@ class _Handler(BaseHTTPRequestHandler):
     # ----------------------------------------------------------- handlers
 
     def _predict(self) -> None:
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype == wire.CONTENT_TYPE:
+            self._predict_wire()
+        else:
+            self._predict_json()
+
+    def _predict_wire(self) -> None:
+        """Binary fast path: one frombuffer decode, no float text on either
+        leg. Error responses stay JSON (typed status + error body) so the
+        client branches on the response Content-Type."""
+        t_parse = time.perf_counter()
+        body = self._read_body()
+        dec = wire.decode_request(body)
+        # the in-frame traceparent wins over the HTTP header: the frame is
+        # the unit a wire client retries/forwards, so its context travels
+        # with it through any proxy that re-writes headers
+        span = tracing.start_span(
+            "serve_request",
+            traceparent=dec.traceparent or self.headers.get("traceparent"))
+        try:
+            timeout_s = (dec.timeout_ms / 1000.0
+                         if dec.timeout_ms is not None else None)
+            span.add_stage("parse", time.perf_counter() - t_parse)
+            version = self.service.registry.get(dec.model).version
+            t0 = time.monotonic()
+            preds = self.service.predict(
+                dec.model, dec.rows, raw_score=dec.raw_score,
+                timeout_s=timeout_s, span=span)
+            t_ser = time.perf_counter()
+            self._send_wire(200, wire.encode_response(
+                preds, version, (time.monotonic() - t0) * 1000.0),
+                headers={"traceparent": span.traceparent()})
+            span.add_stage("serialize", time.perf_counter() - t_ser)
+        finally:
+            span.finish()
+
+    def _predict_json(self) -> None:
         t_parse = time.perf_counter()
         span = tracing.start_span(
             "serve_request", traceparent=self.headers.get("traceparent"))
@@ -230,11 +294,13 @@ class _Handler(BaseHTTPRequestHandler):
         name = payload.get("name")
         if not isinstance(name, str) or not name:
             raise InvalidRequest("missing 'name' (string) field")
+        shard_rows = payload.get("shard_rows")
         info = self.service.load_model(
             name, path=payload.get("path"),
             model_str=payload.get("model_str"),
             expected_sha256=payload.get("expected_sha256"),
-            reject_nonfinite=bool(payload.get("reject_nonfinite", False)))
+            reject_nonfinite=bool(payload.get("reject_nonfinite", False)),
+            shard_rows=int(shard_rows) if shard_rows is not None else None)
         self._send_json(200, info)
 
 

@@ -38,12 +38,16 @@ class ModelEntry:
     """One immutable serving version of one named model."""
 
     def __init__(self, name: str, booster: Booster, sha256: str,
-                 verified: bool, reject_nonfinite: bool) -> None:
+                 verified: bool, reject_nonfinite: bool,
+                 shard_rows: Optional[int] = None,
+                 source_path: Optional[str] = None) -> None:
         self.name = name
         self.booster = booster
         self.sha256 = sha256
         self.verified = verified
         self.reject_nonfinite = reject_nonfinite
+        self.shard_rows = shard_rows  # row-shard threshold for this entry
+        self.source_path = source_path  # where the model text was read from
         self.version = 0  # assigned at publish time
         self.loaded_unix = time.time()
         self.n_features = booster.num_feature()
@@ -53,7 +57,12 @@ class ModelEntry:
     # ------------------------------------------------------------- predict
 
     def predict_device(self, X: np.ndarray, raw_score: bool) -> np.ndarray:
-        """Normal path: the engine's own dispatch (jit cache, streaming)."""
+        """Normal path: the engine's own dispatch (jit cache, streaming).
+        Entries with a `shard_rows` threshold route big micro-batches onto
+        the row-sharded multi-chip path (parallel/predict.py)."""
+        if self.shard_rows is not None:
+            return self.booster.predict(X, raw_score=raw_score,
+                                        pred_shard_rows=self.shard_rows)
         return self.booster.predict(X, raw_score=raw_score)
 
     def _tree_slice_end(self) -> int:
@@ -117,6 +126,7 @@ class ModelEntry:
             "n_features": self.n_features,
             "num_trees": self.booster.num_trees(),
             "reject_nonfinite": self.reject_nonfinite,
+            "shard_rows": self.shard_rows,
             "loaded_unix": self.loaded_unix,
         }
 
@@ -136,7 +146,8 @@ class ModelRegistry:
              model_str: Optional[str] = None,
              booster: Optional[Booster] = None,
              reject_nonfinite: bool = False,
-             expected_sha256: Optional[str] = None) -> ModelEntry:
+             expected_sha256: Optional[str] = None,
+             shard_rows: Optional[int] = None) -> ModelEntry:
         """Stage + verify + parse + publish. Exactly one source among
         `path` / `model_str` / `booster`; an in-process Booster is
         snapshotted through its text export so the served version stays
@@ -192,7 +203,8 @@ class ModelRegistry:
             staged = Booster(model_str=text)
         except Exception as exc:
             self._reject(name, f"unparseable model text: {exc}")
-        entry = ModelEntry(name, staged, sha, verified, reject_nonfinite)
+        entry = ModelEntry(name, staged, sha, verified, reject_nonfinite,
+                           shard_rows=shard_rows, source_path=path)
 
         with self._lock:
             cur = self._models.get(name)

@@ -11,6 +11,13 @@ and never a device dispatch.
 The service polls telemetry.signals() (rate-limited) and feeds the breaker
 so recompile churn or HBM pressure observed by the PR-7 watchers degrades
 chunk sizes before anything actually fails.
+
+Fleet dispatch: `replicas=N` runs N independent MicroBatcher workers in
+one process; each model entry is pinned to a replica at load time
+(round-robin placement), so two hot models coalesce and dispatch
+concurrently instead of serializing through one worker thread. The
+breaker is shared but sharded per entry (breaker.py), so one tenant's
+faulting model sheds only its own load.
 """
 from __future__ import annotations
 
@@ -20,7 +27,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from .. import telemetry, tracing
+from .. import checkpoint, telemetry, tracing
 from ..health import first_nonfinite_column
 from ..utils.log import Log
 from .batcher import MicroBatcher
@@ -36,13 +43,22 @@ class PredictionService:
                  min_bucket: int = 256, batch_window_s: float = 0.001,
                  max_request_rows: Optional[int] = None,
                  default_timeout_s: Optional[float] = None,
-                 signal_poll_s: float = 0.25) -> None:
+                 signal_poll_s: float = 0.25, replicas: int = 1) -> None:
         self.registry = registry or ModelRegistry()
         self.breaker = breaker or CircuitBreaker()
-        self.batcher = MicroBatcher(
-            self.breaker, max_batch_rows=max_batch_rows,
-            max_queue_rows=max_queue_rows, min_bucket=min_bucket,
-            batch_window_s=batch_window_s)
+        self._batchers = [
+            MicroBatcher(self.breaker, max_batch_rows=max_batch_rows,
+                         max_queue_rows=max_queue_rows, min_bucket=min_bucket,
+                         batch_window_s=batch_window_s)
+            for _ in range(max(1, int(replicas)))]
+        # replica 0 keeps the historical single-batcher attribute so
+        # existing callers (tests, tools) read the same surface
+        self.batcher = self._batchers[0]
+        # model entry name -> replica index; assigned round-robin at first
+        # sight and dropped at unload, so a reloaded fleet rebalances
+        self._placement: Dict[str, int] = {}
+        self._placement_next = 0
+        self._placement_lock = threading.Lock()
         self.max_request_rows = max_request_rows or self.batcher.max_batch_rows
         self.default_timeout_s = default_timeout_s
         self.signal_poll_s = signal_poll_s
@@ -60,37 +76,150 @@ class PredictionService:
         # release (the breaker's _maybe_dump convention, checked by R13)
         self._pending_dump: Optional[str] = None
 
+    # ------------------------------------------------------------ placement
+
+    def _batcher_for(self, name: str) -> MicroBatcher:
+        """The replica batcher this entry is pinned to (round-robin
+        assignment at first sight; stable until unload)."""
+        if len(self._batchers) == 1:
+            return self.batcher
+        with self._placement_lock:
+            idx = self._placement.get(name)
+            if idx is None:
+                idx = self._placement_next % len(self._batchers)
+                self._placement[name] = idx
+                self._placement_next += 1
+        return self._batchers[idx]
+
+    def _forget_placement(self, name: str) -> None:
+        with self._placement_lock:
+            self._placement.pop(name, None)
+
     # -------------------------------------------------------------- models
 
     def load_model(self, name: str, **kwargs: Any) -> Dict[str, Any]:
-        """Registry load + jit warmup of every serving bucket, so the new
-        version's first live request never pays a compile."""
+        """Registry load + AOT warm-start install (when a ``.aot`` sidecar
+        rides next to the model file) + jit warmup of every serving bucket,
+        so the new version's first live request never pays a compile."""
         entry = self.registry.load(name, **kwargs)
+        self.breaker.register_entry(name)
+        self._batcher_for(name)  # pin the replica before any traffic
+        aot = self._install_aot(entry)
         self.warmup(name)
         # warmup compiles are expected, not churn — don't let them trip the
         # breaker's recompile signal on the next poll
         self.breaker.rebaseline(telemetry.signals())
-        return entry.info()
+        info = entry.info()
+        info["aot_buckets"] = aot
+        return info
 
     def unload_model(self, name: str) -> bool:
+        self._forget_placement(name)
+        self.breaker.forget_entry(name)
         return self.registry.unload(name)
 
     def models(self) -> List[Dict[str, Any]]:
         return self.registry.info()
 
+    # ------------------------------------------------------------ AOT warm
+
+    def _install_aot(self, entry) -> int:
+        """Install the model's serialized predict executables (if a valid
+        ``.aot`` sidecar rides next to its file) into its predictor cache.
+        Every failure mode — absent, damaged, stale environment, wrong
+        model hash — falls back to fresh compilation with a warning; a
+        bundle can cost a compile, never a wrong answer. Returns the
+        number of bucket executables installed."""
+        from ..ops.predict import aot_load_bundle
+
+        if entry.source_path is None:
+            return 0
+        try:
+            blob = checkpoint.read_aot_sidecar(entry.source_path)
+        except checkpoint.CheckpointError as exc:
+            Log.warning("serving: damaged AOT sidecar for model '%s' (%s); "
+                        "falling back to fresh compiles", entry.name, exc)
+            return 0
+        if blob is None:
+            return 0
+        executables, problems = aot_load_bundle(blob,
+                                                model_sha256=entry.sha256)
+        if problems:
+            Log.warning("serving: AOT bundle for model '%s' refused (%s); "
+                        "falling back to fresh compiles", entry.name,
+                        "; ".join(problems))
+            return 0
+        n = entry.booster._gbdt._predictor.install_aot(executables)
+        Log.info("serving: model '%s' warm-started with %d AOT bucket "
+                 "executable(s)", entry.name, n)
+        tracing.note("aot_installed", model=entry.name, buckets=n)
+        if telemetry.enabled():
+            telemetry.emit("aot_installed", model=entry.name, buckets=n)
+        return n
+
+    def export_aot(self, name: str, path: Optional[str] = None) -> str:
+        """Compile + serialize this entry's per-bucket predict executables
+        and persist them as ``<model path>.aot`` (or next to an explicit
+        `path`). A warm writer calls this once; every cold replica that
+        loads the same model file then skips its per-bucket XLA compiles."""
+        from ..ops.predict import aot_serialize_bundle
+
+        entry = self.registry.get(name)
+        target = path or entry.source_path
+        if target is None:
+            raise ValueError(
+                f"model '{name}' was not loaded from a file; pass an "
+                "explicit path to export its AOT bundle")
+        g = entry.booster._gbdt
+        best = entry.booster.best_iteration
+        packed = g._packed(best if best > 0 else 0, 0)
+        buckets: List[int] = []
+        b = self.batcher.min_bucket
+        while b <= self.batcher.max_batch_rows:
+            buckets.append(b)
+            b <<= 1
+        bundle = aot_serialize_bundle(
+            packed, max(entry.n_features, 1), g.num_tree_per_iteration,
+            buckets, model_sha256=entry.sha256)
+        sidecar = checkpoint.write_aot_sidecar(target, bundle)
+        Log.info("serving: exported AOT bundle for model '%s' (%d buckets, "
+                 "%d bytes) -> %s", name, len(buckets), len(bundle), sidecar)
+        return sidecar
+
     def warmup(self, name: str, max_rows: Optional[int] = None) -> List[int]:
         """Dispatch zeros at each power-of-two bucket (both raw and
         transformed outputs) so the jit cache holds every shape the batcher
-        can produce — the 'zero new compiles under load' contract."""
+        can produce — the 'zero new compiles under load' contract.
+
+        Buckets covered by an installed AOT executable dispatch raw-score
+        only: the single dispatch smoke-tests the deserialized executable
+        (bit-identical traversal, no XLA compile) while the raw=False
+        transform rides the same executable plus one tiny convert_output
+        jit — so an AOT cold start stays milliseconds. If a deserialized
+        executable fails at dispatch, the bundle is dropped and the full
+        compile warmup runs instead."""
         entry = self.registry.get(name)
         cap = min(max_rows or self.batcher.max_batch_rows,
                   self.batcher.max_batch_rows)
+        predictor = entry.booster._gbdt._predictor
+        aot_covered = set(predictor.aot_rows())
         buckets: List[int] = []
         b = self.batcher.min_bucket
         while b <= cap:
             zeros = np.zeros((b, max(entry.n_features, 1)), dtype=np.float32)
-            for raw in (False, True):
-                entry.predict_device(zeros, raw)
+            if b in aot_covered:
+                try:
+                    entry.predict_device(zeros, True)
+                except Exception as exc:  # noqa: BLE001 - drop AOT, recover
+                    Log.warning(
+                        "serving: AOT executable for %d rows failed at "
+                        "warmup (%s); dropping the bundle and compiling "
+                        "fresh", b, exc)
+                    predictor.invalidate()
+                    return self.warmup(name, max_rows)
+            else:
+                for raw in (False, True):
+                    entry.predict_device(zeros, raw)
             buckets.append(b)
             b <<= 1
         return buckets
@@ -115,6 +244,7 @@ class PredictionService:
                 self._resolve_canary_locked(False, "superseded by a newer "
                                             "candidate")
             entry = self.registry.load(canary_name, **kwargs)
+            self.breaker.register_entry(canary_name)
             self.warmup(canary_name)
             self.breaker.rebaseline(telemetry.signals())
             self._canary = {
@@ -201,6 +331,8 @@ class PredictionService:
             self.warmup(c["model"])
             self.breaker.rebaseline(telemetry.signals())
             self.registry.unload(c["canary"])
+            self._forget_placement(c["canary"])
+            self.breaker.forget_entry(c["canary"])
             self._canary_promotions += 1
             Log.info("serving: canary for %r promoted after %d canary "
                      "requests (%s)", c["model"], c["served"], reason)
@@ -211,6 +343,8 @@ class PredictionService:
                                served=c["served"])
         else:
             self.registry.unload(c["canary"])
+            self._forget_placement(c["canary"])
+            self.breaker.forget_entry(c["canary"])
             self._canary_rollbacks += 1
             Log.warning("serving: canary for %r rolled back after %d canary "
                         "requests: %s; primary keeps serving", c["model"],
@@ -258,33 +392,41 @@ class PredictionService:
             span.add_stage("parse", time.perf_counter() - t_parse)
             timeout = (timeout_s if timeout_s is not None
                        else self.default_timeout_s)
+            batcher = self._batcher_for(entry.name)
             if self._canary is not None:
                 canary_entry = self._canary_route(model)
                 if canary_entry is not None:
                     try:
-                        out = self.batcher.submit(canary_entry, X, raw_score,
-                                                  timeout, span=span)
+                        out = self._batcher_for(canary_entry.name).submit(
+                            canary_entry, X, raw_score, timeout, span=span)
                     except Exception as exc:
                         # the candidate failed a live request: roll it back
                         # and answer from the primary — the caller must
                         # never see a canary-induced failure
                         self.resolve_canary(
                             False, f"candidate request failed: {exc}")
-                        return self.batcher.submit(entry, X, raw_score,
-                                                   timeout, span=span)
+                        return batcher.submit(entry, X, raw_score,
+                                              timeout, span=span)
                     self._canary_served(model)
                     return out
-            return self.batcher.submit(entry, X, raw_score, timeout,
-                                       span=span)
+            return batcher.submit(entry, X, raw_score, timeout, span=span)
         finally:
             if own_span:
                 span.finish()
 
     def _validate(self, entry, rows: Any) -> np.ndarray:
-        try:
-            X = np.asarray(rows, dtype=np.float64)
-        except (ValueError, TypeError) as exc:
-            raise InvalidRequest(f"rows are not a numeric matrix: {exc}")
+        if isinstance(rows, np.ndarray) and rows.dtype == np.float32 \
+                and rows.ndim == 2 and rows.flags["C_CONTIGUOUS"]:
+            # binary-wire fast path: the decoder already produced exactly
+            # the dtype/layout the batcher dispatches, so the f64 round
+            # trip below (a full copy per request) is skipped; the shape
+            # and finiteness checks still run on the view
+            X = rows
+        else:
+            try:
+                X = np.asarray(rows, dtype=np.float64)
+            except (ValueError, TypeError) as exc:
+                raise InvalidRequest(f"rows are not a numeric matrix: {exc}")
         if X.ndim == 1:
             X = X.reshape(1, -1)
         if X.ndim != 2:
@@ -307,6 +449,8 @@ class PredictionService:
                     f"non-finite value in feature column {col}; model "
                     f"'{entry.name}' was registered with reject_nonfinite "
                     "(NaN-as-missing disabled)")
+        if X.dtype == np.float32 and X.flags["C_CONTIGUOUS"]:
+            return X
         return np.ascontiguousarray(X, dtype=np.float32)
 
     # ------------------------------------------------------------- signals
@@ -320,8 +464,23 @@ class PredictionService:
 
     # -------------------------------------------------------------- health
 
+    def _batcher_stats(self) -> Dict[str, Any]:
+        """Fleet-aggregate batcher counters: sums for counts, worst-case
+        for the latency quantiles (a replica's tail is the fleet's tail)."""
+        per = [b.stats() for b in self._batchers]
+        if len(per) == 1:
+            return per[0]
+        agg: Dict[str, Any] = {}
+        for st in per:
+            for k, v in st.items():
+                if k in ("p50_ms", "p99_ms"):
+                    agg[k] = max(agg.get(k, 0.0), v)
+                else:
+                    agg[k] = agg.get(k, 0) + v
+        return agg
+
     def healthz(self) -> Dict[str, Any]:
-        stats = self.batcher.stats()
+        stats = self._batcher_stats()
         breaker = self.breaker.info()
         status = "ok"
         if breaker["state"] != "closed":
@@ -346,10 +505,14 @@ class PredictionService:
         # must not load just because a serving facade was constructed
         from ..streaming import drift as _drift
 
+        with self._placement_lock:
+            placement = dict(self._placement)
         return {
             "canary": self.canary_info(),
             "drift": _drift.latest(),
-            "batcher": self.batcher.stats(),
+            "batcher": self._batcher_stats(),
+            "replicas": {"count": len(self._batchers),
+                         "placement": placement},
             "breaker": self.breaker.info(),
             "models": self.registry.info(),
             "swaps": self.registry.swaps,
@@ -367,4 +530,5 @@ class PredictionService:
 
     def close(self) -> None:
         self._closed = True
-        self.batcher.close()
+        for b in self._batchers:
+            b.close()

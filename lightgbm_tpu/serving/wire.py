@@ -1,0 +1,207 @@
+"""Binary wire protocol for the /predict fast path.
+
+The JSON front (serving/http.py) spends most of a small request's budget
+on text: the client renders every float as decimal, the server parses it
+back through `json.loads` + `np.asarray(..., float64)`, and the response
+re-renders the predictions as text. This module defines the sibling
+binary framing negotiated by Content-Type — a length-delimited, versioned
+little-endian format whose row block IS the IEEE-754 array, so the server
+decodes a request with one `np.frombuffer` view (zero copy) and answers
+with the raw float32 prediction bytes.
+
+Request frame (Content-Type: ``application/x-lgbm-wire``)::
+
+    0   4  magic        b"LGBW"
+    4   1  version      1
+    5   1  kind         1 = predict request
+    6   1  dtype        0 = float32, 1 = float64 (row block element type)
+    7   1  flags        bit 0: raw_score
+    8   4  n_rows       uint32
+    12  4  n_cols       uint32
+    16  2  name_len     uint16, UTF-8 model name follows the header
+    18  2  trace_len    uint16, optional W3C traceparent (ASCII) after name
+    20  4  timeout_ms   uint32, 0 = server default
+    24      name bytes | traceparent bytes | row block
+               (n_rows * n_cols elements, C order)
+
+Response frame (same Content-Type on the 200)::
+
+    0   4  magic        b"LGBW"
+    4   1  version      1
+    5   1  kind         2 = predict response
+    6   1  dtype        0 = float32 (prediction element type)
+    7   1  flags        reserved, 0
+    8   4  n_rows       uint32
+    12  4  n_cols       uint32 (1 for binary/regression, C for multiclass)
+    16  4  model_version uint32
+    20  4  latency_ms   float32
+    24      prediction block (n_rows * n_cols float32, C order)
+
+Errors are NOT framed: any failed request keeps the JSON error body
+``{"error", "detail"}`` with the typed status from serving/errors.py, so
+a client can always branch on the response Content-Type. Every frame
+fault (bad magic, unknown version, truncated or oversized row block)
+raises InvalidRequest -> typed 400 naming the offset that disagreed.
+
+The frame length is validated EXACTLY: header + name + trace + row block
+must equal the body length, so truncation and trailing garbage are both
+typed 400s instead of a silently short matrix.
+
+Stdlib + numpy only, same as the rest of the serving stack.
+"""
+from __future__ import annotations
+
+import struct
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from .errors import InvalidRequest
+
+CONTENT_TYPE = "application/x-lgbm-wire"
+
+MAGIC = b"LGBW"
+VERSION = 1
+KIND_PREDICT = 1
+KIND_RESPONSE = 2
+
+DTYPE_F32 = 0
+DTYPE_F64 = 1
+_DTYPES = {DTYPE_F32: np.dtype(np.float32), DTYPE_F64: np.dtype(np.float64)}
+_DTYPE_CODES = {np.dtype(np.float32): DTYPE_F32,
+                np.dtype(np.float64): DTYPE_F64}
+
+FLAG_RAW_SCORE = 1
+
+_REQ = struct.Struct("<4sBBBBIIHHI")   # 24 bytes
+_RESP = struct.Struct("<4sBBBBIIIf")   # 24 bytes
+
+HEADER_BYTES = _REQ.size
+RESPONSE_HEADER_BYTES = _RESP.size
+
+
+class WireRequest(NamedTuple):
+    model: str
+    rows: np.ndarray            # [n_rows, n_cols] zero-copy view of the frame
+    raw_score: bool
+    timeout_ms: Optional[int]   # None = server default
+    traceparent: Optional[str]
+
+
+def encode_request(model: str, rows: np.ndarray, raw_score: bool = False,
+                   timeout_ms: Optional[int] = None,
+                   traceparent: Optional[str] = None) -> bytes:
+    """One request frame. `rows` must be a 2-D float32/float64 matrix;
+    float32 C-contiguous input is framed without a copy of the row block
+    conversion (tobytes still materializes the frame itself)."""
+    X = np.asarray(rows)
+    if X.ndim == 1:
+        X = X.reshape(1, -1)
+    if X.ndim != 2:
+        raise ValueError(f"rows must be 2-D, got {X.ndim}-D")
+    code = _DTYPE_CODES.get(X.dtype)
+    if code is None:
+        X = np.ascontiguousarray(X, dtype=np.float32)
+        code = DTYPE_F32
+    name = model.encode("utf-8")
+    trace = (traceparent or "").encode("ascii")
+    flags = FLAG_RAW_SCORE if raw_score else 0
+    header = _REQ.pack(MAGIC, VERSION, KIND_PREDICT, code, flags,
+                       X.shape[0], X.shape[1], len(name), len(trace),
+                       int(timeout_ms or 0))
+    return b"".join((header, name, trace,
+                     np.ascontiguousarray(X).tobytes()))
+
+
+def decode_request(buf: bytes) -> WireRequest:
+    """Parse one request frame; the returned row matrix is a zero-copy
+    (read-only) view into `buf`."""
+    if len(buf) < HEADER_BYTES:
+        raise InvalidRequest(
+            f"wire frame of {len(buf)} bytes is shorter than the "
+            f"{HEADER_BYTES}-byte header")
+    (magic, version, kind, dtype_code, flags, n_rows, n_cols,
+     name_len, trace_len, timeout_ms) = _REQ.unpack_from(buf)
+    if magic != MAGIC:
+        raise InvalidRequest(
+            f"bad wire magic {magic!r} at offset 0 (expected {MAGIC!r})")
+    if version != VERSION:
+        raise InvalidRequest(
+            f"unsupported wire version {version} (this server speaks "
+            f"version {VERSION})")
+    if kind != KIND_PREDICT:
+        raise InvalidRequest(
+            f"unexpected frame kind {kind} (expected predict request "
+            f"{KIND_PREDICT})")
+    dtype = _DTYPES.get(dtype_code)
+    if dtype is None:
+        raise InvalidRequest(
+            f"unknown row-block dtype code {dtype_code} "
+            f"(known: {sorted(_DTYPES)})")
+    off = HEADER_BYTES
+    block = n_rows * n_cols * dtype.itemsize
+    expected = off + name_len + trace_len + block
+    if len(buf) != expected:
+        raise InvalidRequest(
+            f"wire frame length {len(buf)} does not match the header "
+            f"({n_rows}x{n_cols} {dtype.name} rows after a {name_len}-byte "
+            f"name and {trace_len}-byte traceparent = {expected} bytes)")
+    if n_rows == 0 or n_cols == 0:
+        raise InvalidRequest("empty request: zero-size row block")
+    try:
+        model = bytes(buf[off:off + name_len]).decode("utf-8")
+    except UnicodeDecodeError as exc:
+        raise InvalidRequest(f"model name is not valid UTF-8: {exc}")
+    if not model:
+        raise InvalidRequest("missing model name in wire frame")
+    off += name_len
+    trace = bytes(buf[off:off + trace_len]).decode("ascii", "replace") \
+        if trace_len else None
+    off += trace_len
+    rows = np.frombuffer(buf, dtype=dtype, count=n_rows * n_cols,
+                         offset=off).reshape(n_rows, n_cols)
+    return WireRequest(model=model, rows=rows,
+                       raw_score=bool(flags & FLAG_RAW_SCORE),
+                       timeout_ms=int(timeout_ms) or None,
+                       traceparent=trace)
+
+
+def encode_response(preds: np.ndarray, model_version: int,
+                    latency_ms: float) -> bytes:
+    """One response frame around the float32 prediction block. A 1-D
+    prediction vector frames as n_cols=1 — the shape the JSON path's
+    `predictions` list carries for single-output models."""
+    P = np.asarray(preds, dtype=np.float32)
+    n_cols = 1 if P.ndim == 1 else P.shape[1]
+    header = _RESP.pack(MAGIC, VERSION, KIND_RESPONSE, DTYPE_F32, 0,
+                        P.shape[0], n_cols, int(model_version),
+                        float(latency_ms))
+    return header + np.ascontiguousarray(P).tobytes()
+
+
+def decode_response(buf: bytes) -> Tuple[np.ndarray, int, float]:
+    """(predictions, model_version, latency_ms) from one response frame.
+    1-column blocks come back 1-D, matching PredictionService.predict."""
+    if len(buf) < RESPONSE_HEADER_BYTES:
+        raise InvalidRequest(
+            f"wire response of {len(buf)} bytes is shorter than the "
+            f"{RESPONSE_HEADER_BYTES}-byte header")
+    (magic, version, kind, dtype_code, _flags, n_rows, n_cols,
+     model_version, latency_ms) = _RESP.unpack_from(buf)
+    if magic != MAGIC or version != VERSION or kind != KIND_RESPONSE:
+        raise InvalidRequest(
+            f"bad wire response header (magic {magic!r}, version {version}, "
+            f"kind {kind})")
+    dtype = _DTYPES.get(dtype_code)
+    if dtype is None:
+        raise InvalidRequest(f"unknown response dtype code {dtype_code}")
+    expected = RESPONSE_HEADER_BYTES + n_rows * n_cols * dtype.itemsize
+    if len(buf) != expected:
+        raise InvalidRequest(
+            f"wire response length {len(buf)} does not match its header "
+            f"({n_rows}x{n_cols} {dtype.name} = {expected} bytes)")
+    P = np.frombuffer(buf, dtype=dtype, count=n_rows * n_cols,
+                      offset=RESPONSE_HEADER_BYTES).reshape(n_rows, n_cols)
+    if n_cols == 1:
+        P = P.reshape(n_rows)
+    return P, int(model_version), float(latency_ms)

@@ -7,6 +7,7 @@ import threading
 import time
 
 import jax
+import numpy as np
 
 
 @jax.jit
@@ -58,3 +59,13 @@ class PlantedServer:
         with self._lock:
             # graftlint: disable=lock-discipline -- startup-only seed read: bounded, runs once before serving starts
             return open("/tmp/spmd_seed.json").read()
+
+    def bad_wire_decode(self, stream, n_rows):
+        with self._lock:
+            return np.frombuffer(stream.read(8 * n_rows), dtype=np.float32)  # R13: decode blocks on the socket under _lock
+
+    def good_pending_decode(self, stream, n_rows):
+        payload = stream.read(8 * n_rows)  # clean: socket drained pre-lock
+        with self._lock:
+            self._pending = payload
+        return np.frombuffer(self._pending, dtype=np.float32)

@@ -160,6 +160,55 @@ def test_capi_model_string_roundtrip_and_predict_types(capi):
     _check(lib, lib.LGBM_DatasetFree(ds))
 
 
+def test_capi_predict_for_csr_matches_mat(capi):
+    lib = capi
+    rng = np.random.RandomState(3)
+    n, f = 300, 5
+    X = rng.randn(n, f)
+    X[rng.rand(n, f) < 0.4] = 0.0  # genuinely sparse rows
+    X = np.ascontiguousarray(X, dtype=np.float64)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, b"max_bin=63",
+        None, ctypes.byref(ds)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", y.ctypes.data_as(ctypes.c_void_p), n, 0))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1 device_type=cpu",
+        ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(5):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    out_len = ctypes.c_int64()
+    dense = np.zeros(n, dtype=np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, X.ctypes.data_as(ctypes.c_void_p), 1, n, f, 1, 0, 0, 0, b"",
+        ctypes.byref(out_len),
+        dense.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+
+    rows, cols = np.nonzero(X)
+    values = np.ascontiguousarray(X[rows, cols], dtype=np.float64)
+    indices = np.ascontiguousarray(cols, dtype=np.int32)
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    np.add.at(indptr, rows + 1, 1)
+    indptr = np.cumsum(indptr).astype(np.int64)
+    sparse = np.zeros(n, dtype=np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForCSR(
+        bst, indptr.ctypes.data_as(ctypes.c_void_p), 3,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        values.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(indptr.size), ctypes.c_int64(values.size),
+        ctypes.c_int64(f), 0, 0, 0, b"", ctypes.byref(out_len),
+        sparse.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert out_len.value == n
+    np.testing.assert_array_equal(dense, sparse)
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))
+
+
 def test_capi_error_reporting(capi):
     lib = capi
     bad = ctypes.c_void_p(999999)

@@ -1,0 +1,101 @@
+"""C API parity shims at the impl layer (no compiler needed): drive the
+Python functions behind LGBM_BoosterPredictForMat / PredictForCSR through
+a real cffi FFI — the same buffer/pointer marshalling the embedded build
+uses — and assert both surfaces answer bit-identically with the in-process
+Booster.predict they route onto."""
+import numpy as np
+import pytest
+
+cffi = pytest.importorskip("cffi")
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.capi import impl
+
+
+@pytest.fixture(scope="module")
+def ffi():
+    return cffi.FFI()
+
+
+@pytest.fixture(scope="module")
+def booster_handle():
+    rng = np.random.RandomState(7)
+    X = rng.randn(300, 6)
+    # zero out a third of the entries so the CSR form is genuinely sparse
+    X[rng.rand(300, 6) < 0.33] = 0.0
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 63})
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=5)
+    h = impl._register(bst)
+    yield h, X
+    impl._free(h)
+
+
+def _predict_mat(ffi, handle, X, predict_type=0):
+    Xc = np.ascontiguousarray(X, dtype=np.float64)
+    out = np.zeros(X.shape[0], dtype=np.float64)
+    out_len = ffi.new("int64_t*")
+    ret = impl.booster_predict_for_mat(
+        ffi, handle, ffi.from_buffer("void*", Xc), 1,
+        X.shape[0], X.shape[1], 1, predict_type, 0, 0,
+        ffi.new("char[]", b""), out_len,
+        ffi.from_buffer("double*", out, require_writable=True))
+    assert ret == 0
+    assert out_len[0] == X.shape[0]
+    return out
+
+
+def _predict_csr(ffi, handle, X, predict_type=0):
+    # hand-rolled CSR of X (scipy-free): row pointers + column indices +
+    # the non-zero values, exactly the LGBM_BoosterPredictForCSR ABI
+    rows, cols = np.nonzero(X)
+    values = np.ascontiguousarray(X[rows, cols], dtype=np.float64)
+    indices = np.ascontiguousarray(cols, dtype=np.int32)
+    indptr = np.zeros(X.shape[0] + 1, dtype=np.int64)
+    np.add.at(indptr, rows + 1, 1)
+    indptr = np.cumsum(indptr).astype(np.int64)
+    out = np.zeros(X.shape[0], dtype=np.float64)
+    out_len = ffi.new("int64_t*")
+    ret = impl.booster_predict_for_csr(
+        ffi, handle, ffi.from_buffer("void*", indptr), 3,
+        ffi.from_buffer("int32_t*", indices),
+        ffi.from_buffer("void*", values), 1,
+        indptr.size, values.size, X.shape[1], predict_type, 0, 0,
+        ffi.new("char[]", b""), out_len,
+        ffi.from_buffer("double*", out, require_writable=True))
+    assert ret == 0
+    assert out_len[0] == X.shape[0]
+    return out
+
+
+def test_csr_matches_mat_normal(ffi, booster_handle):
+    h, X = booster_handle
+    np.testing.assert_array_equal(_predict_mat(ffi, h, X),
+                                  _predict_csr(ffi, h, X))
+
+
+def test_csr_matches_mat_raw_score(ffi, booster_handle):
+    h, X = booster_handle
+    np.testing.assert_array_equal(_predict_mat(ffi, h, X, predict_type=1),
+                                  _predict_csr(ffi, h, X, predict_type=1))
+
+
+def test_csr_matches_booster_predict(ffi, booster_handle):
+    h, X = booster_handle
+    want = impl._get(h).predict(X)
+    np.testing.assert_array_equal(_predict_csr(ffi, h, X), want)
+
+
+def test_csr_rejects_bad_indptr_type(ffi, booster_handle):
+    h, X = booster_handle
+    out = np.zeros(X.shape[0], dtype=np.float64)
+    out_len = ffi.new("int64_t*")
+    indptr = np.zeros(X.shape[0] + 1, dtype=np.float64)
+    with pytest.raises(ValueError, match="indptr_type"):
+        impl.booster_predict_for_csr(
+            ffi, h, ffi.from_buffer("void*", indptr), 1,
+            ffi.new("int32_t[1]"), ffi.new("double[1]"), 1,
+            indptr.size, 0, X.shape[1], 0, 0, 0,
+            ffi.new("char[]", b""), out_len,
+            ffi.from_buffer("double*", out, require_writable=True))

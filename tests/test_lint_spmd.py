@@ -130,23 +130,30 @@ def test_voting_wrapped_waves_stay_quiet(result):
 
 def test_r13_blocking_under_lock_flagged(result):
     bad = _hits(result, "lock-discipline", "serving/locks.py")
-    assert [v.line for v in bad] == [29, 33, 38]
+    assert [v.line for v in bad] == [30, 34, 39, 65]
     assert "jitted dispatch _dev_double" in bad[0].message
     assert "file I/O (open)" in bad[1].message
     # the sleep lives two frames away: the finding names the chain
-    assert "time.sleep at serving/locks.py:18" in bad[2].message
+    assert "time.sleep at serving/locks.py:19" in bad[2].message
+    # wire-protocol plant: np.frombuffer over a blocking stream read holds
+    # the batcher lock for the peer's send pace
+    assert "np.frombuffer decodes a blocking stream read" in bad[3].message
+    assert ".read" in bad[3].message
 
 
 def test_r13_pending_record_idiom_stays_quiet(result):
-    # good_pending writes its file AFTER releasing the lock (line 53)
+    # good_pending writes its file AFTER releasing the lock (line 54);
+    # good_pending_decode drains the stream pre-lock and decodes after
+    # release (line 71)
     lines = {v.line for v in _hits(result, "lock-discipline")}
-    assert 53 not in lines
+    assert 54 not in lines
+    assert 71 not in lines and 68 not in lines
 
 
 def test_r13_suppression_honored(result):
     sup = _hits(result, "lock-discipline", "serving/locks.py",
                 suppressed=True)
-    assert [v.line for v in sup] == [60]
+    assert [v.line for v in sup] == [61]
     assert "startup-only" in sup[0].reason
 
 
@@ -154,7 +161,7 @@ def test_r13_suppression_honored(result):
 
 def test_r13_lock_order_cycle_both_directions(result):
     bad = _hits(result, "lock-order-cycle", "serving/locks.py")
-    assert sorted(v.line for v in bad) == [42, 47]
+    assert sorted(v.line for v in bad) == [43, 48]
     assert all("acquisition-order cycle" in v.message for v in bad)
     assert all("PlantedServer._lock" in v.message
                and "PlantedServer._aux" in v.message for v in bad)

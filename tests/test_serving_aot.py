@@ -1,0 +1,180 @@
+"""AOT warm-start suite: export_aot -> fresh replica loads the sidecar
+and serves bit-identically without recompiling; every refusal path
+(stale environment fingerprint, wrong model hash, damaged sidecar,
+missing sidecar) warns and falls back to fresh compiles — a bad bundle
+can cost a compile, never a wrong answer.
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import checkpoint
+from lightgbm_tpu.serving import PredictionService
+from lightgbm_tpu.utils.timer import global_timer
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 5}
+
+
+@pytest.fixture(scope="module")
+def exported(tmp_path_factory):
+    """A trained model saved to disk with an AOT sidecar exported next
+    to it by a warm service, plus reference predictions."""
+    rng = np.random.RandomState(7)
+    X = rng.rand(400, 10)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float64)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=8)
+    mpath = str(tmp_path_factory.mktemp("aot") / "model.txt")
+    bst.save_model(mpath)
+    warm = PredictionService(max_batch_rows=256, batch_window_s=0.0)
+    warm.load_model("m", path=mpath)
+    sidecar = warm.export_aot("m")
+    warm.close()
+    assert sidecar == mpath + checkpoint.AOT_SUFFIX
+    assert os.path.exists(sidecar)
+    Q = np.ascontiguousarray(rng.rand(64, 10), dtype=np.float32)
+    want_raw = bst.predict(Q, raw_score=True).astype(np.float32)
+    want = bst.predict(Q).astype(np.float32)
+    return mpath, sidecar, Q, want_raw, want
+
+
+def _fresh_service():
+    return PredictionService(max_batch_rows=256, batch_window_s=0.0)
+
+
+def test_cold_replica_installs_bundle_and_matches(exported):
+    mpath, _, Q, want_raw, want = exported
+    svc = _fresh_service()
+    try:
+        before = global_timer.counters["predict_aot_hits"]
+        info = svc.load_model("cold", path=mpath)
+        assert info["aot_buckets"] > 0
+        # warmup already dispatched the AOT-covered buckets
+        assert global_timer.counters["predict_aot_hits"] > before
+        hits = global_timer.counters["predict_aot_hits"]
+        # the block pads up to an exported bucket -> AOT dispatch
+        got_raw = svc.predict("cold", Q, raw_score=True)
+        assert np.array_equal(got_raw, want_raw)
+        assert global_timer.counters["predict_aot_hits"] > hits
+        # transformed output rides the same executable + convert_output
+        assert np.array_equal(svc.predict("cold", Q), want)
+    finally:
+        svc.close()
+
+
+def test_stale_environment_fingerprint_falls_back(exported, capsys):
+    mpath, sidecar, Q, want_raw, _ = exported
+    obj = pickle.loads(checkpoint.read_aot_sidecar(mpath))
+    obj["environment"]["jax"] = "0.0.0-stale"
+    stale = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    svc = _fresh_service()
+    try:
+        checkpoint.write_aot_sidecar(mpath, stale)
+        info = svc.load_model("stale", path=mpath)
+        assert info["aot_buckets"] == 0
+        assert "fingerprint mismatch" in capsys.readouterr().out
+        # fallback recompiles and still answers bit-identically
+        assert np.array_equal(svc.predict("stale", Q, raw_score=True),
+                              want_raw)
+    finally:
+        svc.close()
+        # restore the good sidecar for tests that follow
+        svc2 = _fresh_service()
+        svc2.load_model("m", path=mpath)
+        svc2.export_aot("m")
+        svc2.close()
+
+
+def test_wrong_model_hash_refused(exported, tmp_path):
+    mpath, _, Q, want_raw, _ = exported
+    obj = pickle.loads(checkpoint.read_aot_sidecar(mpath))
+    obj["model_sha256"] = "0" * 64
+    other = str(tmp_path / "model.txt")
+    with open(mpath) as fh:
+        text = fh.read()
+    with open(other, "w") as fh:
+        fh.write(text)
+    checkpoint.write_aot_sidecar(
+        other, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+    svc = _fresh_service()
+    try:
+        info = svc.load_model("wrong", path=other)
+        assert info["aot_buckets"] == 0
+        assert np.array_equal(svc.predict("wrong", Q, raw_score=True),
+                              want_raw)
+    finally:
+        svc.close()
+
+
+def test_damaged_sidecar_falls_back(exported, tmp_path, capsys):
+    mpath, _, Q, want_raw, _ = exported
+    other = str(tmp_path / "model.txt")
+    with open(mpath) as fh:
+        text = fh.read()
+    with open(other, "w") as fh:
+        fh.write(text)
+    good = checkpoint.read_aot_sidecar(mpath)
+    # zero the stored digest so read_aot_sidecar rejects the sidecar
+    with open(other + checkpoint.AOT_SUFFIX, "wb") as fh:
+        fh.write(checkpoint.AOT_MAGIC + b"\x00" * 32 + good)
+    svc = _fresh_service()
+    try:
+        info = svc.load_model("dmg", path=other)
+        assert info["aot_buckets"] == 0
+        assert "damaged AOT sidecar" in capsys.readouterr().out
+        assert np.array_equal(svc.predict("dmg", Q, raw_score=True),
+                              want_raw)
+    finally:
+        svc.close()
+
+
+def test_missing_sidecar_is_silent_zero(exported, tmp_path):
+    mpath, _, Q, want_raw, _ = exported
+    other = str(tmp_path / "model.txt")
+    with open(mpath) as fh:
+        text = fh.read()
+    with open(other, "w") as fh:
+        fh.write(text)
+    svc = _fresh_service()
+    try:
+        info = svc.load_model("nosc", path=other)
+        assert info["aot_buckets"] == 0
+        assert np.array_equal(svc.predict("nosc", Q, raw_score=True),
+                              want_raw)
+    finally:
+        svc.close()
+
+
+def test_export_requires_a_path_for_in_process_boosters(exported):
+    mpath, _, _, _, _ = exported
+    rng = np.random.RandomState(8)
+    X = rng.rand(200, 10)
+    y = (X[:, 0] > 0.5).astype(np.float64)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=3)
+    svc = _fresh_service()
+    try:
+        svc.load_model("mem", booster=bst)
+        with pytest.raises(ValueError, match="explicit path"):
+            svc.export_aot("mem")
+    finally:
+        svc.close()
+
+
+def test_sidecar_io_roundtrip(tmp_path):
+    path = str(tmp_path / "anything.txt")
+    assert checkpoint.read_aot_sidecar(path) is None
+    blob = b"\x01\x02payload" * 9
+    sc = checkpoint.write_aot_sidecar(path, blob)
+    assert checkpoint.read_aot_sidecar(path) == blob
+    with open(sc, "r+b") as fh:
+        fh.seek(len(checkpoint.AOT_MAGIC) + 32 + 2)
+        fh.write(b"\xff")
+    with pytest.raises(checkpoint.CheckpointError, match="checksum"):
+        checkpoint.read_aot_sidecar(path)
+    with open(sc, "wb") as fh:
+        fh.write(b"NOTMAGIC" + blob)
+    with pytest.raises(checkpoint.CheckpointError, match="magic"):
+        checkpoint.read_aot_sidecar(path)

@@ -1,0 +1,267 @@
+"""Fleet dispatch suite: replica placement + aggregate stats, per-entry
+breaker shards (one faulting model cannot shed its neighbours),
+pred_shard_rows routing through a model entry, and the batcher's
+zero-copy exact-bucket-fit pad path.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import CircuitBreaker, PredictionService
+from lightgbm_tpu.serving import batcher as batcher_mod
+from lightgbm_tpu.serving.batcher import MicroBatcher
+from lightgbm_tpu.serving.breaker import CLOSED, OPEN
+from lightgbm_tpu.utils import faults
+from lightgbm_tpu.utils.timer import global_timer
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 5}
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.clear()
+
+
+def _train(rng, n=400, seed_col=0):
+    X = rng.rand(n, 10)
+    y = (X[:, seed_col] + X[:, 1] > 1.0).astype(np.float64)
+    return lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=8)
+
+
+# ----------------------------------------------------------- pad zero-copy
+
+
+def test_pad_exact_bucket_fit_is_zero_copy():
+    b = MicroBatcher(max_batch_rows=1024, min_bucket=256,
+                     batch_window_s=0.0)
+    try:
+        chunk = np.zeros((256, 6), dtype=np.float32)
+        assert b._pad(chunk, 1024) is chunk
+        full = np.zeros((1024, 6), dtype=np.float32)
+        assert b._pad(full, 1024) is full
+    finally:
+        b.close()
+
+
+def test_pad_exact_fit_never_allocates():
+    b = MicroBatcher(max_batch_rows=1024, min_bucket=256,
+                     batch_window_s=0.0)
+
+    calls = []
+    real_zeros = np.zeros
+
+    class _SpyNp:
+        def __getattr__(self, name):
+            if name == "zeros":
+                def spy(*a, **kw):
+                    calls.append(a)
+                    return real_zeros(*a, **kw)
+                return spy
+            return getattr(np, name)
+
+    try:
+        batcher_mod.np = _SpyNp()
+        chunk = np.ones((512, 4), dtype=np.float32)
+        out = b._pad(chunk, 1024)
+        assert out is chunk and not calls
+        # a ragged tail still pays exactly one pad allocation
+        ragged = np.ones((300, 4), dtype=np.float32)
+        padded = b._pad(ragged, 1024)
+        assert padded.shape == (512, 4) and len(calls) == 1
+        assert np.array_equal(padded[:300], ragged)
+        assert not padded[300:].any()
+    finally:
+        batcher_mod.np = np
+        b.close()
+
+
+def test_pad_exact_fit_noncontiguous_still_copies():
+    b = MicroBatcher(max_batch_rows=1024, min_bucket=256,
+                     batch_window_s=0.0)
+    try:
+        base = np.zeros((256, 12), dtype=np.float32)
+        view = base[:, ::2]                     # not C-contiguous
+        out = b._pad(view, 1024)
+        assert out is not view
+        assert out.flags["C_CONTIGUOUS"] and out.dtype == np.float32
+        f64 = np.zeros((256, 6), dtype=np.float64)
+        out64 = b._pad(f64, 1024)
+        assert out64 is not f64 and out64.dtype == np.float32
+    finally:
+        b.close()
+
+
+# ------------------------------------------------------ per-entry breaker
+
+
+def test_breaker_shards_isolate_entries(rng):
+    breaker = CircuitBreaker(fail_threshold=2, probe_successes=1,
+                             cooldown_s=60.0)
+    svc = PredictionService(max_batch_rows=1024, batch_window_s=0.0,
+                            breaker=breaker)
+    try:
+        svc.load_model("a", booster=_train(rng, seed_col=0))
+        svc.load_model("b", booster=_train(rng, seed_col=2))
+        Q = np.ascontiguousarray(rng.rand(17, 10), dtype=np.float32)
+        want_a = svc.predict("a", Q)
+        want_b = svc.predict("b", Q)
+        # fail the next two device dispatches — both aimed at entry 'a'
+        faults.install("predict_fail@1:2")
+        for _ in range(2):
+            assert np.array_equal(svc.predict("a", Q), want_a)  # host retry
+        info = svc.breaker.info()
+        assert info["entries"]["a"]["state"] == OPEN
+        assert info["entries"]["b"]["state"] == CLOSED
+        assert info["state"] == OPEN            # aggregate = worst shard
+        # 'b' still serves on the DEVICE: its dispatch succeeds and its
+        # shard stays closed while 'a' is host-pinned
+        assert np.array_equal(svc.predict("b", Q), want_b)
+        info = svc.breaker.info()
+        assert info["entries"]["b"]["state"] == CLOSED
+        assert info["entries"]["a"]["state"] == OPEN
+        # 'a' keeps answering bit-identically through the host path
+        host_chunks = svc.batcher.n_host_chunks
+        assert np.array_equal(svc.predict("a", Q), want_a)
+        assert svc.batcher.n_host_chunks > host_chunks
+    finally:
+        svc.close()
+
+
+def test_unload_forgets_breaker_shard(rng):
+    breaker = CircuitBreaker(fail_threshold=1, probe_successes=1,
+                             cooldown_s=60.0)
+    svc = PredictionService(max_batch_rows=1024, batch_window_s=0.0,
+                            breaker=breaker)
+    try:
+        svc.load_model("a", booster=_train(rng))
+        Q = np.ascontiguousarray(rng.rand(9, 10), dtype=np.float32)
+        faults.install("predict_fail@1:1")
+        svc.predict("a", Q)
+        assert svc.breaker.info()["state"] == OPEN
+        svc.unload_model("a")
+        # the tripped shard leaves with its entry: aggregate recovers
+        assert svc.breaker.info()["state"] == CLOSED
+        assert "entries" not in svc.breaker.info()
+    finally:
+        svc.close()
+
+
+# ------------------------------------------------------- replica dispatch
+
+
+def test_replica_placement_and_aggregate_stats(rng):
+    svc = PredictionService(max_batch_rows=1024, batch_window_s=0.0,
+                            replicas=2)
+    try:
+        bst0, bst1 = _train(rng, seed_col=0), _train(rng, seed_col=3)
+        svc.load_model("m0", booster=bst0)
+        svc.load_model("m1", booster=bst1)
+        stats = svc.stats()
+        assert stats["replicas"]["count"] == 2
+        placement = stats["replicas"]["placement"]
+        assert placement["m0"] != placement["m1"]
+        Q = np.ascontiguousarray(rng.rand(25, 10), dtype=np.float32)
+        got0 = svc.predict("m0", Q, raw_score=True)
+        got1 = svc.predict("m1", Q, raw_score=True)
+        assert np.array_equal(
+            got0, bst0.predict(Q, raw_score=True).astype(np.float32))
+        assert np.array_equal(
+            got1, bst1.predict(Q, raw_score=True).astype(np.float32))
+        # aggregate batcher stats sum the per-replica counters
+        agg = svc.stats()["batcher"]
+        assert agg["requests"] == sum(b.n_requests for b in svc._batchers)
+        assert agg["rows"] >= 2 * 25
+        assert svc.healthz()["status"] == "ok"
+    finally:
+        svc.close()
+
+
+def test_replica_placement_is_sticky_and_forgotten_on_unload(rng):
+    svc = PredictionService(max_batch_rows=1024, batch_window_s=0.0,
+                            replicas=3)
+    try:
+        svc.load_model("m0", booster=_train(rng))
+        first = svc.stats()["replicas"]["placement"]["m0"]
+        Q = np.ascontiguousarray(rng.rand(5, 10), dtype=np.float32)
+        for _ in range(4):
+            svc.predict("m0", Q)
+        assert svc.stats()["replicas"]["placement"]["m0"] == first
+        svc.unload_model("m0")
+        assert "m0" not in svc.stats()["replicas"]["placement"]
+    finally:
+        svc.close()
+
+
+def test_replica_concurrent_models_bit_exact(rng):
+    svc = PredictionService(max_batch_rows=1024, batch_window_s=0.0,
+                            replicas=2)
+    try:
+        boosters = [_train(rng, seed_col=i) for i in range(4)]
+        for i, bst in enumerate(boosters):
+            svc.load_model(f"m{i}", booster=bst)
+        Q = np.ascontiguousarray(rng.rand(16, 10), dtype=np.float32)
+        want = [b.predict(Q).astype(np.float32) for b in boosters]
+        got = [None] * 4
+        errs = []
+
+        def fire(i):
+            try:
+                for _ in range(5):
+                    got[i] = svc.predict(f"m{i}", Q)
+            except Exception as exc:  # noqa: BLE001 - surface in main thread
+                errs.append(exc)
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        for i in range(4):
+            assert np.array_equal(got[i], want[i])
+    finally:
+        svc.close()
+
+
+# ------------------------------------------------------- row-sharded path
+
+
+def test_entry_shard_rows_routes_sharded_predict(rng):
+    import jax
+
+    if jax.device_count() <= 1:
+        pytest.skip("needs the multi-device test harness")
+    svc = PredictionService(max_batch_rows=1024, batch_window_s=0.0)
+    try:
+        bst = _train(rng)
+        svc.load_model("s", booster=bst, shard_rows=1)
+        entry_info = {e["name"]: e for e in svc.stats()["models"]}
+        assert entry_info["s"]["shard_rows"] == 1
+        Q = np.ascontiguousarray(rng.rand(64, 10), dtype=np.float32)
+        before = global_timer.counters["predict_sharded_rows"]
+        got = svc.predict("s", Q, raw_score=True)
+        assert global_timer.counters["predict_sharded_rows"] > before
+        # bit-identical to the single-chip answer
+        assert np.array_equal(
+            got, bst.predict(Q, raw_score=True).astype(np.float32))
+    finally:
+        svc.close()
+
+
+def test_pred_shard_rows_kwarg_bit_identical(rng):
+    import jax
+
+    if jax.device_count() <= 1:
+        pytest.skip("needs the multi-device test harness")
+    bst = _train(rng)
+    X = rng.rand(333, 10)              # pads + crops across 8 devices
+    single = bst.predict(X, raw_score=True)
+    before = global_timer.counters["predict_sharded_rows"]
+    sharded = bst.predict(X, raw_score=True, pred_shard_rows=1)
+    assert global_timer.counters["predict_sharded_rows"] >= before + 333
+    np.testing.assert_array_equal(single, sharded)

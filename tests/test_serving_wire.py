@@ -1,0 +1,260 @@
+"""Binary wire protocol suite (serving/wire.py + the HTTP fast path):
+codec round-trips and zero-copy decode, every frame-fault -> typed
+InvalidRequest, bit-identity with the JSON path over a live server —
+including through the breaker's host-fallback path — and traceparent
+propagation from inside the frame.
+"""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import (CircuitBreaker, InvalidRequest,
+                                  PredictionService)
+from lightgbm_tpu.serving import wire
+from lightgbm_tpu.serving.http import serve
+from lightgbm_tpu.utils import faults
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 5}
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------- codec
+
+
+def test_request_roundtrip_f32():
+    rng = np.random.RandomState(0)
+    X = np.ascontiguousarray(rng.rand(13, 7), dtype=np.float32)
+    frame = wire.encode_request("m", X, raw_score=True, timeout_ms=250,
+                                traceparent="00-" + "ab" * 16 + "-"
+                                + "cd" * 8 + "-01")
+    dec = wire.decode_request(frame)
+    assert dec.model == "m"
+    assert dec.raw_score is True
+    assert dec.timeout_ms == 250
+    assert dec.traceparent.startswith("00-")
+    assert dec.rows.dtype == np.float32
+    assert np.array_equal(dec.rows, X)
+
+
+def test_request_roundtrip_f64_and_defaults():
+    X = np.arange(12, dtype=np.float64).reshape(3, 4)
+    dec = wire.decode_request(wire.encode_request("model-x", X))
+    assert dec.rows.dtype == np.float64
+    assert np.array_equal(dec.rows, X)
+    assert dec.raw_score is False
+    assert dec.timeout_ms is None
+    assert dec.traceparent is None
+
+
+def test_decode_is_zero_copy():
+    X = np.ascontiguousarray(np.random.rand(8, 5), dtype=np.float32)
+    frame = wire.encode_request("m", X)
+    dec = wire.decode_request(frame)
+    # a view into the frame, not a copy: base chains back to the buffer
+    assert dec.rows.base is not None
+    assert not dec.rows.flags["OWNDATA"]
+
+
+def test_response_roundtrip():
+    preds = np.linspace(0, 1, 9, dtype=np.float32)
+    buf = wire.encode_response(preds, model_version=3, latency_ms=1.5)
+    got, version, latency = wire.decode_response(buf)
+    assert np.array_equal(got, preds)
+    assert version == 3
+    assert latency == pytest.approx(1.5, abs=1e-3)
+
+
+def test_response_multiclass_keeps_2d():
+    preds = np.random.rand(6, 3).astype(np.float32)
+    got, _, _ = wire.decode_response(
+        wire.encode_response(preds, model_version=1, latency_ms=0.0))
+    assert got.shape == (6, 3)
+    assert np.array_equal(got, preds)
+
+
+@pytest.mark.parametrize("mangle, needle", [
+    (lambda f: b"", "shorter than"),
+    (lambda f: f[:20], "shorter than"),
+    (lambda f: b"XXXX" + f[4:], "bad wire magic"),
+    (lambda f: f[:4] + b"\x09" + f[5:], "unsupported wire version"),
+    (lambda f: f[:5] + b"\x07" + f[6:], "unexpected frame kind"),
+    (lambda f: f[:6] + b"\x09" + f[7:], "unknown row-block dtype"),
+    (lambda f: f[:-4], "does not match"),
+    (lambda f: f + b"\x00" * 8, "does not match"),
+])
+def test_frame_faults_are_typed(mangle, needle):
+    X = np.zeros((2, 3), dtype=np.float32)
+    frame = wire.encode_request("m", X)
+    with pytest.raises(InvalidRequest, match=needle):
+        wire.decode_request(mangle(frame))
+
+
+def test_empty_block_and_missing_name_rejected():
+    hdr = wire._REQ.pack(wire.MAGIC, wire.VERSION, wire.KIND_PREDICT,
+                         wire.DTYPE_F32, 0, 0, 3, 1, 0, 0)
+    with pytest.raises(InvalidRequest, match="empty request"):
+        wire.decode_request(hdr + b"m")
+    X = np.zeros((1, 2), dtype=np.float32)
+    frame = wire.encode_request("", X)
+    with pytest.raises(InvalidRequest, match="missing model name"):
+        wire.decode_request(frame)
+
+
+# ------------------------------------------------------------- HTTP path
+
+
+@pytest.fixture(scope="module")
+def served():
+    rng = np.random.RandomState(42)
+    X = rng.rand(500, 10)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float64)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=8)
+    breaker = CircuitBreaker(fail_threshold=2, probe_successes=1,
+                             cooldown_s=30.0)
+    svc = PredictionService(max_batch_rows=1024, batch_window_s=0.0,
+                            breaker=breaker)
+    svc.load_model("m", booster=bst)
+    server, thread = serve(svc, port=0)
+    yield server.port, bst, svc
+    server.shutdown()
+    svc.close()
+
+
+def _post_wire(port, body, traceparent=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict", data=body,
+        headers={"Content-Type": wire.CONTENT_TYPE})
+    if traceparent:
+        req.add_header("traceparent", traceparent)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return (resp.status, resp.read(),
+                dict((k.lower(), v) for k, v in resp.headers.items()))
+
+
+def test_wire_predict_bit_identical_to_json(served):
+    port, bst, _ = served
+    rng = np.random.RandomState(1)
+    Q = np.ascontiguousarray(rng.rand(33, 10), dtype=np.float32)
+    status, body, headers = _post_wire(
+        port, wire.encode_request("m", Q, raw_score=True))
+    assert status == 200
+    assert headers["content-type"] == wire.CONTENT_TYPE
+    preds, version, latency = wire.decode_response(body)
+    assert version == 1 and latency >= 0.0
+    # JSON path answer for the SAME rows
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps({"model": "m", "rows": Q.tolist(),
+                         "raw_score": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        via_json = np.asarray(json.loads(resp.read())["predictions"],
+                              dtype=np.float32)
+    assert np.array_equal(preds, via_json)
+    # and both equal the direct engine answer
+    assert np.array_equal(
+        preds, bst.predict(Q, raw_score=True).astype(np.float32))
+
+
+def test_wire_f64_request_matches_json(served):
+    port, bst, _ = served
+    rng = np.random.RandomState(2)
+    Q = rng.rand(9, 10)  # float64 block on the wire
+    status, body, _ = _post_wire(port, wire.encode_request("m", Q))
+    assert status == 200
+    preds, _, _ = wire.decode_response(body)
+    assert np.array_equal(preds, bst.predict(Q).astype(np.float32))
+
+
+def test_wire_errors_are_json_bodies(served):
+    port, _, _ = served
+    # corrupt frame -> typed 400 with a JSON error body the client can
+    # branch on via Content-Type
+    frame = wire.encode_request("m", np.zeros((2, 10), dtype=np.float32))
+    for bad, status, err in (
+            (b"XXXX" + frame[4:], 400, "invalid_request"),
+            (frame[:-8], 400, "invalid_request"),
+            (wire.encode_request("ghost",
+                                 np.zeros((1, 10), dtype=np.float32)),
+             404, "model_not_found")):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict", data=bad,
+            headers={"Content-Type": wire.CONTENT_TYPE})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == status
+        ctype = ei.value.headers.get("Content-Type", "")
+        assert ctype.startswith("application/json")
+        assert json.loads(ei.value.read())["error"] == err
+
+
+def test_wire_traceparent_in_frame_wins(served):
+    port, _, _ = served
+    frame_trace = "00-" + "1a" * 16 + "-" + "2b" * 8 + "-01"
+    header_trace = "00-" + "3c" * 16 + "-" + "4d" * 8 + "-01"
+    body = wire.encode_request("m", np.zeros((1, 10), dtype=np.float32),
+                               traceparent=frame_trace)
+    status, _, headers = _post_wire(port, body, traceparent=header_trace)
+    assert status == 200
+    # the response's traceparent continues the FRAME's trace id
+    assert headers["traceparent"].split("-")[1] == "1a" * 16
+
+
+def test_wire_bit_identical_on_host_fallback(served):
+    port, bst, svc = served
+    rng = np.random.RandomState(3)
+    Q = np.ascontiguousarray(rng.rand(21, 10), dtype=np.float32)
+    want = bst.predict(Q).astype(np.float32)
+    # trip the per-entry breaker: two failed device dispatches open it
+    faults.install("predict_fail@1:10")
+    for _ in range(3):
+        status, body, _ = _post_wire(port, wire.encode_request("m", Q))
+        assert status == 200
+        preds, _, _ = wire.decode_response(body)
+        assert np.array_equal(preds, want)
+    assert svc.breaker.info()["state"] == "open"
+    # breaker OPEN -> host-pinned path; still bit-identical on the wire
+    status, body, _ = _post_wire(port, wire.encode_request("m", Q))
+    assert status == 200
+    preds, _, _ = wire.decode_response(body)
+    assert np.array_equal(preds, want)
+    faults.clear()
+    # reset the tripped shard so later tests see a closed breaker
+    svc.breaker.forget_entry("m")
+    svc.breaker.register_entry("m")
+    assert svc.breaker.info()["state"] == "closed"
+
+
+def test_wire_concurrent_clients_bit_exact(served):
+    port, bst, _ = served
+    rng = np.random.RandomState(4)
+    blocks = [np.ascontiguousarray(rng.rand(16, 10), dtype=np.float32)
+              for _ in range(10)]
+    want = [bst.predict(b, raw_score=True).astype(np.float32)
+            for b in blocks]
+    got = [None] * len(blocks)
+
+    def fire(i):
+        _, body, _ = _post_wire(
+            port, wire.encode_request("m", blocks[i], raw_score=True))
+        got[i] = wire.decode_response(body)[0]
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(len(blocks))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(len(blocks)):
+        assert np.array_equal(got[i], want[i])

@@ -51,6 +51,9 @@ SPEC: Dict[str, Metric] = {
     "quantized_row_iters_per_sec": Metric("higher", 0.15, "perf"),
     "predict_rows_per_sec": Metric("higher", 0.15, "perf"),
     "serve_rows_per_sec": Metric("higher", 0.25, "perf"),
+    "serve_wire_binary_rows_per_sec": Metric("higher", 0.25, "perf"),
+    "serve_cold_start_ms": Metric("lower", 1.00, "perf"),
+    "serve_replica_scaling_efficiency": Metric("higher", 0.50, "perf"),
     "serve_p50_ms": Metric("lower", 0.50, "perf"),
     "serve_p99_ms": Metric("lower", 1.00, "perf"),
     "checkpoint_write_ms": Metric("lower", 1.00, "perf"),

@@ -223,6 +223,9 @@ class LockDisciplineRule(Rule):
                 return ("time.sleep", call.lineno)
             if last == "block_until_ready":
                 return ("block_until_ready device sync", call.lineno)
+            if _stream_decode_arg(call) is not None:
+                return ("blocking stream decode (np.frombuffer on .%s)"
+                        % _stream_decode_arg(call), call.lineno)
             if last in ("device_put", "device_get") \
                     or name.split(".")[0] == "jnp" \
                     or name.startswith("jax.numpy"):
@@ -332,6 +335,11 @@ class LockDisciplineRule(Rule):
                         and call.args[0].id in device_names:
                     hit = ("np.%s on a device array pulls it to host "
                            "under %s" % (last, held[-1]))
+                elif _stream_decode_arg(call) is not None:
+                    hit = ("np.frombuffer decodes a blocking stream read "
+                           "(.%s) under %s — drain the stream before "
+                           "taking the lock" % (_stream_decode_arg(call),
+                                                held[-1]))
                 elif last in ("device_put", "device_get") \
                         or name.split(".")[0] == "jnp":
                     hit = "device op (%s) under %s" % (name, held[-1])
@@ -443,6 +451,22 @@ class LockDisciplineRule(Rule):
                         "these orders deadlock — pick one global order"
                         % (b, a, a, b)))
         return out
+
+
+def _stream_decode_arg(call: ast.Call) -> Optional[str]:
+    """The stream method name when this call is
+    ``np.frombuffer(<x>.read(...))`` / ``.recv(...)`` — a zero-copy decode
+    whose SOURCE is a blocking socket/file read, so holding a lock across
+    it stalls every waiter on the peer's send pace; else None."""
+    if dotted_name(call.func).rsplit(".", 1)[-1] != "frombuffer":
+        return None
+    if not call.args or not isinstance(call.args[0], ast.Call):
+        return None
+    src = call.args[0].func
+    if isinstance(src, ast.Attribute) and src.attr in ("read", "recv",
+                                                       "recv_into"):
+        return src.attr
+    return None
 
 
 def graph_module(ctx) -> str:

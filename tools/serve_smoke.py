@@ -134,6 +134,47 @@ def main() -> int:
         check("typed 400 names feature count", status == 400
               and "5 features" in body.get("detail", ""))
 
+        # binary wire path: bit-exact vs JSON, typed errors, traceparent
+        from lightgbm_tpu.serving import wire
+
+        def wire_call(body, traceparent=None):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=body,
+                headers={"Content-Type": wire.CONTENT_TYPE})
+            if traceparent:
+                req.add_header("traceparent", traceparent)
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return resp.status, resp.read(), dict(
+                        (k.lower(), v) for k, v in resp.headers.items())
+            except urllib.error.HTTPError as exc:
+                return exc.code, exc.read(), dict(
+                    (k.lower(), v) for k, v in exc.headers.items())
+
+        qw = np.ascontiguousarray(queries[0], dtype=np.float32)
+        status, body, headers = wire_call(wire.encode_request("m", qw))
+        wire_ok = status == 200 \
+            and headers["content-type"] == wire.CONTENT_TYPE
+        if wire_ok:
+            preds, _, _ = wire.decode_response(body)
+            wire_ok = np.array_equal(preds, expected[0])
+        check("binary wire bit-exact vs JSON", wire_ok)
+
+        frame = wire.encode_request("m", qw)
+        status, body, headers = wire_call(b"XXXX" + frame[4:])
+        check("corrupt wire frame is a typed 400",
+              status == 400
+              and headers["content-type"].startswith("application/json")
+              and json.loads(body).get("error") == "invalid_request",
+              f"got {status}")
+
+        trace = "00-" + "5e" * 16 + "-" + "6f" * 8 + "-01"
+        status, _, headers = wire_call(
+            wire.encode_request("m", qw, traceparent=trace))
+        check("wire traceparent propagated", status == 200
+              and headers.get("traceparent", "").split("-")[1] == "5e" * 16,
+              headers.get("traceparent", "<none>"))
+
         # breaker flap under injected dispatch failures: requests keep
         # answering bit-exact from the host path while the breaker opens,
         # and the flight recorder auto-dumps the postmortem
@@ -208,6 +249,40 @@ def main() -> int:
 
         server.shutdown()
         svc.close()
+
+        # AOT cold start: a warm writer exports compiled executables; a
+        # cold replica loading the same file must come up in a small
+        # fraction of the compile-on-first-request time
+        import jax
+
+        warm = PredictionService(max_batch_rows=1024, batch_window_s=0.0)
+        warm.load_model("m", path=model_path)
+        warm.export_aot("m")
+        warm.close()
+        probe = np.ascontiguousarray(X[:256], dtype=np.float32)
+
+        def cold_start_s(drop_aot):
+            if drop_aot:
+                os.remove(model_path + checkpoint.AOT_SUFFIX)
+            jax.clear_caches()
+            svc2 = PredictionService(max_batch_rows=1024,
+                                     batch_window_s=0.0)
+            t0 = time.perf_counter()
+            info = svc2.load_model("cold", path=model_path)
+            out = svc2.predict("cold", probe, raw_score=True)
+            dt = time.perf_counter() - t0
+            svc2.close()
+            return dt, info["aot_buckets"], out
+
+        t_aot, buckets, out_aot = cold_start_s(drop_aot=False)
+        t_compile, no_buckets, out_cold = cold_start_s(drop_aot=True)
+        check("AOT sidecar installed on cold load", buckets > 0
+              and no_buckets == 0, f"{buckets}/{no_buckets}")
+        check("AOT and compiled cold starts bit-identical",
+              np.array_equal(out_aot, out_cold))
+        check("AOT cold start <= 10% of compile cold start",
+              t_aot <= 0.10 * t_compile,
+              f"aot {t_aot * 1e3:.0f}ms vs compile {t_compile * 1e3:.0f}ms")
 
     if tel_dir:
         telemetry.stop()
