@@ -822,6 +822,70 @@ def run_bench(n_rows: int) -> dict:
         except Exception as e:  # noqa: BLE001 - secondary must not kill primary
             out["stream_error"] = repr(e)[:200]
 
+    # gang-sharded streaming capture (docs/STREAMING.md "Pod-scale
+    # streaming"): chunked ingest through ShardedRowBlockStore (the rank-
+    # merged sketch fit wall lands in stream_sketch_merge_ms), then
+    # training through the gang-sharded learner — tree_learner=data +
+    # quantized histograms, the psum-merged path — under the same starved
+    # budget. The overlap ratio is re-measured on the gang run (the
+    # per-gang stream_h2d_overlap_pct). On a single-device host the gang
+    # degenerates to one shard; the code path and merge timing still
+    # capture.
+    if os.environ.get("BENCH_STREAMING", "1") not in ("0", "false"):
+        try:
+            from lightgbm_tpu.streaming import (ShardedRowBlockStore,
+                                                wrap_dataset)
+
+            s_rows = min(n_rows, 200_000)
+            push_chunk = 16_384
+            sh_store = ShardedRowBlockStore(params=params)
+            for lo in range(0, s_rows, push_chunk):
+                hi = min(s_rows, lo + push_chunk)
+                sh_store.push_rows(X[lo:hi], label=y[lo:hi])
+            sh_core = sh_store.finalize()
+            out["stream_sketch_merge_ms"] = round(
+                global_timer.counters.get("stream_sketch_merge_us", 0)
+                / 1000.0, 3)
+
+            block_rows = max(256, -(-s_rows // 8))
+            budget = 2 * perfmodel.stream_block_bytes(
+                block_rows, sh_core.bins.shape[0],
+                sh_core.bins.dtype.itemsize)
+            sh_params = {**params, "tree_learner": "data",
+                         "use_quantized_grad": True}
+            saved = {k: os.environ.get(k) for k in
+                     ("LGBM_TPU_HBM_BUDGET", "LGBM_TPU_STREAM_BLOCK_ROWS")}
+            os.environ["LGBM_TPU_HBM_BUDGET"] = str(int(budget))
+            os.environ["LGBM_TPU_STREAM_BLOCK_ROWS"] = str(block_rows)
+            base = {k: int(global_timer.counters.get(k, 0)) for k in
+                    ("stream_h2d_prefetched", "stream_h2d_cold")}
+            try:
+                bsh = lgb.Booster(
+                    params=sh_params,
+                    train_set=wrap_dataset(sh_core, params=sh_params))
+                bsh.update()  # compile warmup, not timed
+                t0 = time.perf_counter()
+                for _ in range(N_ITERS):
+                    bsh.update()
+                sh_s = time.perf_counter() - t0
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+            out["stream_sharded_rows_per_sec"] = round(
+                s_rows * N_ITERS / sh_s, 1)
+            c = global_timer.counters
+            out["stream_gang_shards"] = int(c.get("stream_shards", 1))
+            pre = int(c.get("stream_h2d_prefetched", 0)
+                      ) - base["stream_h2d_prefetched"]
+            cold = int(c.get("stream_h2d_cold", 0)) - base["stream_h2d_cold"]
+            out["stream_h2d_overlap_pct"] = round(
+                100.0 * pre / max(pre + cold, 1), 2)
+        except Exception as e:  # noqa: BLE001 - secondary must not kill primary
+            out["stream_sharded_error"] = repr(e)[:200]
+
     # pod-scale learner comm capture (docs/PERF_NOTES.md round-9): the
     # three-way ICI model (data vs voting vs feature) on a fixed wide
     # dataset — cost is independent of n_rows, so it always runs
@@ -912,6 +976,8 @@ def main() -> None:
                       "stream_train_rows_per_sec", "hbm_resident_fraction",
                       "stream_h2d_overlap_pct", "drift_check_overhead_pct",
                       "bin_refresh_ms", "gate_eval_ms", "stream_error",
+                      "stream_sharded_rows_per_sec", "stream_sketch_merge_ms",
+                      "stream_gang_shards", "stream_sharded_error",
                       "wave_commit_rate", "adaptive_k_final",
                       "scan_kernel_ms", "goss_device_gather_ms",
                       "scan_kernel_error", "goss_kernel_error",

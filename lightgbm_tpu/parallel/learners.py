@@ -786,6 +786,25 @@ class DeviceFeatureParallelTreeLearner(DeviceDataParallelTreeLearner):
         global_timer.set_count("feature_ici_bytes_per_wave", bytes_w)
 
 
+def _streamed_learner_or_none(learner_type: str, config: Config,
+                              dataset: Dataset):
+    from ..streaming.learner import streaming_requested
+
+    if not streaming_requested():
+        return None
+    # LGBM_TPU_HBM_BUDGET + a parallel learner: the plane must stay
+    # host-resident, so route to the gang-sharded streamed learner
+    # (streaming/sharded.py) instead of the resident device mesh
+    if learner_type != "data":
+        Log.fatal("LGBM_TPU_HBM_BUDGET streaming supports "
+                  "tree_learner=serial or data only (got %s): feature/"
+                  "voting learners need the full plane device-resident",
+                  learner_type)
+    from ..streaming.sharded import ShardedStreamedTreeLearner
+
+    return ShardedStreamedTreeLearner(config, dataset)
+
+
 def create_parallel_learner(learner_type: str, config: Config,
                             dataset: Dataset):
     from ..treelearner.cegb import CEGB
@@ -800,6 +819,9 @@ def create_parallel_learner(learner_type: str, config: Config,
     if CEGB.enabled(config):
         Log.fatal("cegb_* parameters are not supported with distributed "
                   "tree learners (use tree_learner=serial)")
+    streamed = _streamed_learner_or_none(learner_type, config, dataset)
+    if streamed is not None:
+        return streamed
     # device growth shards the whole-tree wave learner over the mesh (one
     # dispatch per tree); host-driven leaf-wise growth stays the fallback
     # for configs the device grower cannot serve

@@ -21,20 +21,32 @@ Four pieces (docs/STREAMING.md):
                     + bin-occupancy PSI scoring against the binning-time
                     reference, driving alarms and the scheduled bin-mapper
                     refresh (LGBM_TPU_DRIFT / LGBM_TPU_BIN_REFRESH_EVERY).
+  * sharded.py    — pod-scale composition: ShardedRowBlockStore (round-
+                    robin block placement + rank-merged sketch binning),
+                    PodDriftMonitor (gang-merged drift state), and
+                    ShardedStreamedTreeLearner (gang-sharded block cache
+                    + psum-merged quantized histograms) behind
+                    tree_learner=data + LGBM_TPU_HBM_BUDGET.
 """
 from .continuous import ContinuousTrainer, GenerationRejected
-from .drift import DriftMonitor, QuantileSketch
+from .drift import DriftMonitor, QuantileSketch, merge_ranked
 from .ingest import RowBlockStore, wrap_dataset
 from .learner import (StreamedTreeLearner, stream_budget_bytes,
                       streaming_requested)
+from .sharded import (PodDriftMonitor, ShardedRowBlockStore,
+                      ShardedStreamedTreeLearner)
 
 __all__ = [
     "ContinuousTrainer",
     "DriftMonitor",
     "GenerationRejected",
+    "PodDriftMonitor",
     "QuantileSketch",
     "RowBlockStore",
+    "ShardedRowBlockStore",
+    "ShardedStreamedTreeLearner",
     "StreamedTreeLearner",
+    "merge_ranked",
     "stream_budget_bytes",
     "streaming_requested",
     "wrap_dataset",

@@ -127,6 +127,15 @@ class QuantileSketch:
         self._compact()
         return self
 
+    def copy(self) -> "QuantileSketch":
+        dup = QuantileSketch(self.k)
+        dup.levels = [lv.copy() for lv in self.levels]
+        dup.nonzero_n = self.nonzero_n
+        dup.zero_n = self.zero_n
+        dup.nan_n = self.nan_n
+        dup._parity = self._parity
+        return dup
+
     def healthy(self) -> bool:
         """update() strips NaN before storing, so a NaN inside a level is
         impossible organically — it is the ``sketch_corrupt`` signature
@@ -156,6 +165,30 @@ class QuantileSketch:
         ranks = (np.arange(m, dtype=np.float64) + 0.5) / m * cum[-1]
         idx = np.searchsorted(cum, ranks, side="left")
         return vals[np.minimum(idx, len(vals) - 1)]
+
+
+def merge_ranked(pairs) -> QuantileSketch:
+    """Order-canonicalized gang merge: fold ``(rank, sketch)`` pairs in
+    ascending RANK order into a fresh sketch, leaving the inputs intact.
+
+    ``QuantileSketch.merge`` is order-dependent (concatenation order and
+    the alternating compaction parity both depend on the fold sequence),
+    so merging shard sketches in arrival order would make the merged
+    sketch — and therefore refreshed cut points — differ across reruns
+    and across ranks. Canonicalizing on the rank key makes the result a
+    pure function of the shard sketches, byte-stable no matter which
+    order the gang's payloads landed in.
+    """
+    items = sorted(pairs, key=lambda rs: int(rs[0]))
+    ranks = [int(r) for r, _ in items]
+    if len(set(ranks)) != len(ranks):
+        raise ValueError("merge_ranked needs distinct ranks, got %r" % ranks)
+    if not items:
+        return QuantileSketch()
+    out = items[0][1].copy()
+    for _, sk in items[1:]:
+        out.merge(sk)
+    return out
 
 
 # ------------------------------------------------------------ refitting

@@ -20,9 +20,11 @@ Bit-identity with the resident learner (the acceptance bar):
     gather would produce; padded positions carry bin 0 with gh == 0, a
     contribution of exactly 0.0 to the same accumulator cells. The chunk
     sums therefore reassociate nothing and the histogram is bitwise equal
-    on the XLA path (the streamed learner always takes the XLA histogram,
-    never the Pallas kernel — TPU runs wanting Pallas bit-parity should
-    keep the plane resident).
+    on the XLA path. On TPU (or under LGBM_TPU_STREAM_RAGGED) the per-
+    block path routes through pallas_histogram_slots_ragged instead —
+    `_leaf_hist_ragged` — which is bit-identical for quantized training
+    (int32 accumulation) and carries the resident Pallas path's per-tile
+    reassociation caveat for float training.
   * `_partition_split` uploads the chosen group's host plane row — the
     same values `bins_dev[gi]` would hold — so RowPartition's stable
     3-way-key argsort compaction sees identical inputs.
@@ -38,6 +40,7 @@ is no separate resident branch to drift.
 from __future__ import annotations
 
 import os
+import threading
 from collections import OrderedDict
 from functools import partial
 from time import perf_counter
@@ -49,7 +52,11 @@ import numpy as np
 
 from ..config import Config
 from ..io.dataset import Dataset
-from ..ops.histogram import DEFAULT_ROW_CHUNK, _acc_dtype, _hist_chunk
+from ..ops.hist_pallas import (DEFAULT_TILE_ROWS, active_tile_table,
+                               hist_force_f32,
+                               pallas_histogram_slots_ragged)
+from ..ops.histogram import (DEFAULT_ROW_CHUNK, _acc_dtype, _hist_chunk,
+                             _use_pallas)
 from ..ops.partition import pad_indices
 from ..ops.score import binned_leaf_index, binned_tree_arrays
 from ..treelearner.serial import SerialTreeLearner
@@ -57,6 +64,11 @@ from ..utils.timer import global_timer
 
 BUDGET_ENV = "LGBM_TPU_HBM_BUDGET"
 BLOCK_ROWS_ENV = "LGBM_TPU_STREAM_BLOCK_ROWS"
+# per-block histogram kernel routing: "" auto (ragged Pallas wherever the
+# resident learner would take Pallas, i.e. TPU), "0" force XLA scatter,
+# "1" force the compiled ragged kernel, "interpret" force the kernel in
+# Pallas interpret mode (CPU-testable bit-exactness harness)
+RAGGED_ENV = "LGBM_TPU_STREAM_RAGGED"
 DEFAULT_BLOCK_ROWS = 65536
 # per-split group-row uploads kept warm for repeated splits on one group
 _ROW_CACHE_SLOTS = 4
@@ -111,12 +123,26 @@ def _hist_chunk_accum(acc: jax.Array, bins_c: jax.Array, gh_c: jax.Array,
                              compute_dtype)
 
 
+# reservation marker for a prefetch whose H2D dispatch is still outside the
+# lock — distinguishable from any real jax.Array
+_PENDING = object()
+
+
 class _BlockCache:
     """LRU device cache over fixed-width column blocks of the host plane.
 
     `prefetch(b)` dispatches the H2D copy without blocking; a later
     `get(b)` promotes the in-flight array into the resident set. The
     prefetched/cold split feeds `stream_h2d_overlap_pct`.
+
+    Thread safety: map mutation (resident/inflight insert, LRU eviction)
+    happens only under `_lock`; the jitted `jnp.asarray` upload dispatch
+    always runs OUTSIDE it (R13 discipline — a compile under the lock
+    would stall every concurrent reader). A prefetch first parks a
+    `_PENDING` reservation under the lock, uploads, then fills the
+    reservation only if a racing `get` has not claimed the key; a `get`
+    that pops a still-pending reservation simply takes the cold path and
+    the prefetcher's late fill is dropped.
     """
 
     def __init__(self, plane: np.ndarray, block_rows: int, capacity: int,
@@ -128,7 +154,8 @@ class _BlockCache:
         self.capacity = max(1, int(capacity))
         self.upload_dtype = upload_dtype
         self._resident: "OrderedDict[int, jax.Array]" = OrderedDict()
-        self._inflight: Dict[int, jax.Array] = {}
+        self._inflight: Dict[int, object] = {}
+        self._lock = threading.Lock()
         self.upload_s = 0.0
 
     def block_range(self, b: int):
@@ -148,32 +175,43 @@ class _BlockCache:
         return arr
 
     def prefetch(self, b: int) -> None:
-        if b in self._resident or b in self._inflight:
-            return
-        if self.capacity < 2:
-            return  # one slot: prefetching would evict the working block
-        if len(self._resident) + len(self._inflight) >= self.capacity:
-            if not self._resident:
+        with self._lock:
+            if b in self._resident or b in self._inflight:
                 return
-            self._resident.popitem(last=False)
-        self._inflight[b] = self._upload(b)
+            if self.capacity < 2:
+                return  # one slot: prefetching would evict the working block
+            if len(self._resident) + len(self._inflight) >= self.capacity:
+                if not self._resident:
+                    return
+                self._resident.popitem(last=False)
+            self._inflight[b] = _PENDING
+        arr = self._upload(b)  # jitted dispatch: lock released
+        with self._lock:
+            if self._inflight.get(b) is _PENDING:
+                self._inflight[b] = arr
+            # else a racing get() claimed (and cold-loaded) the block while
+            # the upload was in flight — drop this copy on the floor
 
     def get(self, b: int) -> jax.Array:
-        arr = self._resident.pop(b, None)
-        if arr is not None:
-            self._resident[b] = arr  # LRU refresh
-            global_timer.add_count("stream_cache_hits", 1)
-            return arr
-        arr = self._inflight.pop(b, None)
+        with self._lock:
+            arr = self._resident.pop(b, None)
+            if arr is not None:
+                self._resident[b] = arr  # LRU refresh
+                global_timer.add_count("stream_cache_hits", 1)
+                return arr
+            arr = self._inflight.pop(b, None)
+            if arr is _PENDING:
+                arr = None  # reservation not yet filled: go cold
         if arr is not None:
             global_timer.add_count("stream_h2d_prefetched", 1)
         else:
             global_timer.add_count("stream_h2d_cold", 1)
-            arr = self._upload(b)
-        self._resident[b] = arr
-        while (len(self._resident) + len(self._inflight) > self.capacity
-               and len(self._resident) > 1):
-            self._resident.popitem(last=False)
+            arr = self._upload(b)  # jitted dispatch: lock released
+        with self._lock:
+            self._resident[b] = arr
+            while (len(self._resident) + len(self._inflight) > self.capacity
+                   and len(self._resident) > 1):
+                self._resident.popitem(last=False)
         return arr
 
 
@@ -227,10 +265,31 @@ class StreamedTreeLearner(SerialTreeLearner):
 
     # ------------------------------------------------------- histograms
 
+    def _ragged_mode(self) -> Optional[str]:
+        """Resolve RAGGED_ENV at call time (mirrors _use_pallas's unjitted
+        dispatch contract): None = XLA scatter, else 'compiled'|'interpret'."""
+        mode = os.environ.get(RAGGED_ENV, "")
+        if mode == "0":
+            return None
+        if mode == "interpret":
+            return "interpret"
+        if mode == "1":
+            return "compiled"
+        return "compiled" if _use_pallas() else None
+
     def _leaf_hist(self, leaf: int) -> jax.Array:
+        mode = self._ragged_mode()
+        if mode is not None:
+            return self._leaf_hist_ragged(leaf, interpret=mode == "interpret")
         # the padded leaf index set is already host-materialized inside
         # RowPartition; this pull does not sync any new device work
         idx = np.asarray(self.partition.indices(leaf))
+        return self._hist_over_indices(idx)
+
+    def _hist_over_indices(self, idx: np.ndarray) -> jax.Array:
+        """The canonical chunk-order histogram fold over an explicit row
+        index set — `_leaf_hist`'s body, split out so the sharded learner
+        can fold per-rank subsets through the identical bracketing."""
         compute_dtype = jnp.int8 if self.quantized else jnp.float32
         num_bins = self.group_bin_padded
         chunk = DEFAULT_ROW_CHUNK
@@ -256,6 +315,70 @@ class StreamedTreeLearner(SerialTreeLearner):
                 self._prefetch_for(chunks[k + 1])
             gh_c = jnp.take(self._gh, jnp.asarray(chunks[k]), axis=0)
             acc = _hist_chunk_accum(acc, buf, gh_c, num_bins, compute_dtype)
+        return acc
+
+    def _leaf_hist_ragged(self, leaf: int, interpret: bool = False
+                          ) -> jax.Array:
+        """Per-block leaf histogram through the ragged Pallas slots kernel.
+
+        Each cached block slab is fed to pallas_histogram_slots_ragged
+        whole (padded to the tile grid) with a 1-slot table: rows of this
+        leaf carry slot 0, every other row the dump slot, and the active-
+        tile table restricts the grid to the tiles the leaf actually
+        touches — per-block cost is O(tiles overlapping the leaf), not
+        O(block_rows). The next block's H2D prefetch is dispatched while
+        the current block's kernel is in flight (the same double buffer
+        as the XLA chunk fold). Quantized histograms accumulate int32 and
+        are bit-identical to the scatter path in any block order; float
+        histograms reassociate per-tile partial sums, the same caveat the
+        resident Pallas path carries.
+        """
+        idx = np.asarray(self.partition.indices(leaf))
+        vi = idx[idx < self.num_data].astype(np.int64)
+        return self._ragged_over_indices(vi, interpret=interpret)
+
+    def _ragged_over_indices(self, vi: np.ndarray,
+                             interpret: bool = False) -> jax.Array:
+        num_bins = self.group_bin_padded
+        G = len(self.dataset.groups)
+        CH = int(self._gh.shape[1])
+        acc_dtype = jnp.int32 if self.quantized else jnp.float32
+        acc = jnp.zeros((G, num_bins, CH), dtype=acc_dtype)
+        if vi.size == 0:
+            return acc
+        vi = np.asarray(vi).astype(np.int64)
+        cache = self._cache
+        tr = DEFAULT_TILE_ROWS
+        bid = vi // cache.block_rows
+        blocks = np.unique(bid)  # ascending: deterministic fold order
+        global_timer.add_count("stream_ragged_leaves", 1)
+        for i, b in enumerate(blocks):
+            bins_b = cache.get(int(b))
+            if i + 1 < len(blocks):
+                # next block's H2D rides behind this block's kernel in the
+                # device queue — the double buffer
+                cache.prefetch(int(blocks[i + 1]))
+            sel = vi[bid == b]
+            lo, hi = cache.block_range(int(b))
+            width = hi - lo
+            padded = -(-width // tr) * tr
+            if bins_b.shape[1] < padded:
+                bins_b = jnp.pad(bins_b,
+                                 ((0, 0), (0, padded - bins_b.shape[1])))
+            loc = jnp.asarray((sel - lo).astype(np.int32))
+            slot = jnp.ones((padded,), jnp.int32).at[loc].set(0)
+            gh_rows = jnp.take(self._gh, jnp.asarray(sel),
+                               axis=0).astype(jnp.float32)
+            gh = jnp.zeros((padded, CH), jnp.float32).at[loc].set(gh_rows)
+            tiles, n_act = active_tile_table(
+                jnp.asarray([sel[0] - lo], jnp.int32),
+                jnp.asarray([sel[-1] - lo + 1], jnp.int32),
+                jnp.asarray([True]), padded // tr, tr)
+            part = pallas_histogram_slots_ragged(
+                bins_b, gh, slot, tiles, n_act, num_bins, 1, tile_rows=tr,
+                quantized=self.quantized, f32=hist_force_f32(),
+                interpret=interpret)
+            acc = acc + part.astype(acc_dtype)
         return acc
 
     def _prefetch_for(self, idx_chunk: np.ndarray) -> None:

@@ -1,0 +1,27 @@
+"""R12 in streaming/ scope: the gang sketch-merge allgather shapes.
+
+The plant gates the bin-fit sketch merge on rank 0 — every other rank
+never posts the all_gather, so the gang's fit deadlocks at the merge
+barrier. The compliant merge posts it unconditionally on every rank;
+the single-process fallback mirrors the production sharded-ingest
+_allgather_bytes and carries the sanctioned uniformity suppression.
+"""
+import jax
+
+
+def rank0_sketch_merge(sk):
+    merged = sk
+    if jax.process_index() == 0:  # R12(a): only rank 0 posts the merge
+        merged = jax.lax.all_gather(sk, "data", axis=0, tiled=True)
+    return merged
+
+
+def every_rank_merge(sk):
+    return jax.lax.all_gather(sk, "data", axis=0, tiled=True)
+
+
+def single_process_fit(sk):
+    # graftlint: disable=collective-order -- process_count() is uniform across the gang: every rank skips the merge together below the multi-process world size
+    if jax.process_count() == 1:
+        return sk
+    return every_rank_merge(sk)

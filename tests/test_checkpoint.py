@@ -174,6 +174,7 @@ def test_resume_restores_early_stop_state(tmp_path):
             == resumed.model_to_string(num_iteration=-1))
 
 
+@pytest.mark.slow  # tier-1 budget triage: heavy full-training driver, runs in the slow tier
 def test_resume_bit_identical_sharded_8_devices(tmp_path):
     """tree_learner=data on the fake 8-device mesh: every device holds a
     shard of the restored state and the resumed run matches the straight

@@ -25,7 +25,11 @@ def _sweep_predictions(bst, feature, others, lo=-3, hi=3, k=64):
     return bst.predict(X)
 
 
-@pytest.mark.parametrize("learner", ["serial", "data"])
+@pytest.mark.parametrize("learner", [
+    "serial",
+    # the data-parallel leg re-trains on the 8-device mesh: slow tier
+    pytest.param("data", marks=pytest.mark.slow),
+])
 def test_monotone_constraints_enforced(rng, learner):
     X, y = _mono_data(rng)
     params = {"objective": "regression", "num_leaves": 31,

@@ -119,6 +119,7 @@ def test_device_hist_rows_counter(rng):
     assert "device_hist_rows" in global_timer.report()
 
 
+@pytest.mark.slow  # tier-1 budget triage: heavy full-training driver, runs in the slow tier
 def test_device_pallas_interpret_matches_serial(rng, monkeypatch):
     """End-to-end coverage of the Pallas ragged-histogram + compaction wave
     path on CPU via interpret mode (on TPU this is the production path)."""
@@ -241,7 +242,9 @@ _PLANE_VARIANTS = {
 
 @pytest.mark.parametrize("variant,interpret", [
     ("plain", False), ("bagged", False), ("quantized", False),
-    ("plain", True), ("quantized", True),
+    # interpret-mode legs pay Python per wave: slow tier (budget triage)
+    pytest.param("plain", True, marks=pytest.mark.slow),
+    pytest.param("quantized", True, marks=pytest.mark.slow),
 ])
 def test_device_uint8_vs_i32_bit_identical(rng, monkeypatch, variant,
                                            interpret):
@@ -317,6 +320,7 @@ def _adaptive_run(X, y, params, n_iters, adaptive, monkeypatch):
     return bst, learner, ks, rows
 
 
+@pytest.mark.slow  # tier-1 budget triage: heavy full-training driver, runs in the slow tier
 def test_adaptive_wave_width_byte_identical_and_cheaper(rng, monkeypatch):
     """The wave-width controller only changes how much speculative work a
     wave dispatches, never which splits win: split decisions are replayed
@@ -350,6 +354,7 @@ def test_adaptive_wave_width_byte_identical_and_cheaper(rng, monkeypatch):
     assert global_timer.counters.get("wave_k") == l_off.wave_k
 
 
+@pytest.mark.slow  # tier-1 budget triage: heavy full-training driver, runs in the slow tier
 def test_adaptive_wave_width_bounded_recompiles(rng, monkeypatch):
     """Satellite 2: K moves only along bucket_size power-of-two rungs, so
     the static `batch` arg of grow_tree_on_device takes at most

@@ -58,6 +58,26 @@ def test_r12_sanctioned_suppression_honored(result):
     assert "uniform across the gang" in sup[0].reason
 
 
+# -- R12 over the streaming/ scope (the sharded-ingest sketch merge) ------
+
+def test_r12_streaming_sketch_merge_plant_flagged(result):
+    bad = _hits(result, "collective-order", "streaming/sharded_ingest.py")
+    assert [v.line for v in bad] == [14]
+    assert "[all_gather@data] vs []" in bad[0].message
+
+
+def test_r12_streaming_uniform_merge_quiet_and_fallback_suppressed(result):
+    # every_rank_merge posts the merge unconditionally (line 20): quiet —
+    # the plant at 14 is the module's only live finding
+    lines = {v.line for v in _hits(result, "collective-order",
+                                   "streaming/sharded_ingest.py")}
+    assert lines == {14}
+    sup = _hits(result, "collective-order", "streaming/sharded_ingest.py",
+                suppressed=True)
+    assert [v.line for v in sup] == [25]
+    assert "uniform across the gang" in sup[0].reason
+
+
 # -- R12(b) rank-local loop trip counts -----------------------------------
 
 def test_r12_rank_local_loop_flagged(result):

@@ -240,6 +240,44 @@ def test_benchdiff_incomparable_records_skip_not_fail(tmp_path):
     assert out.returncode == 1, out.stdout
 
 
+def test_benchdiff_gates_stream_sharded_metrics(tmp_path):
+    """Pod-streaming SPEC entries: throughput gates as perf (skipped in
+    CI's deterministic-only mode); the overlap/merge pair gates
+    everywhere inside wide deterministic tolerances."""
+    old = _record(stream_sharded_rows_per_sec=1000.0,
+                  stream_h2d_overlap_pct=80.0, stream_sketch_merge_ms=10.0)
+    # throughput halves: a perf regression ...
+    out = _run_benchdiff(tmp_path, old,
+                         _record(stream_sharded_rows_per_sec=400.0,
+                                 stream_h2d_overlap_pct=80.0,
+                                 stream_sketch_merge_ms=10.0))
+    assert out.returncode == 1, out.stdout
+    assert "stream_sharded_rows_per_sec" in out.stdout
+    # ... that deterministic-only CI mode does NOT gate on
+    out = _run_benchdiff(tmp_path, old,
+                         _record(stream_sharded_rows_per_sec=400.0,
+                                 stream_h2d_overlap_pct=80.0,
+                                 stream_sketch_merge_ms=10.0),
+                         "--deterministic-only")
+    assert out.returncode == 0, out.stdout
+    # overlap collapsing past the 25-point allowance gates even there
+    out = _run_benchdiff(tmp_path, old,
+                         _record(stream_sharded_rows_per_sec=1000.0,
+                                 stream_h2d_overlap_pct=20.0,
+                                 stream_sketch_merge_ms=10.0),
+                         "--deterministic-only")
+    assert out.returncode == 1, out.stdout
+    assert "stream_h2d_overlap_pct" in out.stdout
+    # a merge wall blowing through the 250ms allowance gates too
+    out = _run_benchdiff(tmp_path, old,
+                         _record(stream_sharded_rows_per_sec=1000.0,
+                                 stream_h2d_overlap_pct=80.0,
+                                 stream_sketch_merge_ms=700.0),
+                         "--deterministic-only")
+    assert out.returncode == 1, out.stdout
+    assert "stream_sketch_merge_ms" in out.stdout
+
+
 def test_benchdiff_gates_against_committed_baseline():
     """The committed CPU baseline must self-gate clean (the CI invocation)."""
     baseline = os.path.join(_REPO, "BENCH_BASELINE_CPU.json")

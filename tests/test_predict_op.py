@@ -206,6 +206,7 @@ def _trained_ensembles(rng):
     return out
 
 
+@pytest.mark.slow  # tier-1 budget triage: heavy full-training driver, runs in the slow tier
 def test_fused_bit_identical_to_per_tree_reference(rng):
     for name, packed, X, C in _trained_ensembles(rng):
         got = np.asarray(predict_raw(packed, jnp.asarray(X), C))

@@ -28,6 +28,7 @@ def _train(X, y, extra=None, rounds=30):
     return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=rounds)
 
 
+@pytest.mark.slow  # tier-1 budget triage: heavy full-training driver, runs in the slow tier
 def test_quantized_auc_parity(rng):
     X, y = _binary(rng)
     auc_float = _auc(y, _train(X, y).predict(X))
@@ -53,6 +54,7 @@ def test_quantized_nearest_rounding(rng):
     assert _auc(y, bst.predict(X)) > 0.85
 
 
+@pytest.mark.slow  # tier-1 budget triage: heavy full-training driver, runs in the slow tier
 def test_quantized_data_parallel_matches_serial(rng):
     """Same seed -> identical int gradients -> the data-parallel integer
     psum_scatter (int16-narrowed here: 2000 rows x 4 bins < 32000) must

@@ -62,11 +62,14 @@ def _plane_bytes(params, X, y):
 # ------------------------------------------------ streamed-vs-resident
 
 @pytest.mark.parametrize("extra", [
-    {},
-    {"bagging_fraction": 0.7, "bagging_freq": 1},
-    {"feature_fraction": 0.8},
-    {"use_quantized_grad": True},
-], ids=["plain", "bagged", "featfrac", "quantized"])
+    # plain/bagged legs are the heaviest: slow tier (tier-1 budget
+    # triage); featfrac + quantized keep the bound in every tier-1 run
+    pytest.param({}, id="plain", marks=pytest.mark.slow),
+    pytest.param({"bagging_fraction": 0.7, "bagging_freq": 1}, id="bagged",
+                 marks=pytest.mark.slow),
+    pytest.param({"feature_fraction": 0.8}, id="featfrac"),
+    pytest.param({"use_quantized_grad": True}, id="quantized"),
+])
 def test_streamed_bit_identical_starved_budget(monkeypatch, extra):
     """Budget = 2 blocks of 8 (plane is exactly 4x the budget): the
     acceptance bound — eviction + prefetch churn must not move a bit."""

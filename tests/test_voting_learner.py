@@ -148,6 +148,7 @@ def test_voting_quantized_driver_bit_identical(rng):
 
 # ------------------------------------------------------- comm-model gauges
 
+@pytest.mark.slow  # tier-1 budget triage: heavy full-training driver, runs in the slow tier
 def test_voting_ici_gauge_independent_of_f(rng):
     """THE voting claim (perfmodel.voting_ici_bytes_per_wave): per-wave
     ICI volume depends on top_k, never on F. max_bin=16 so both widths
@@ -170,6 +171,7 @@ def test_voting_ici_gauge_independent_of_f(rng):
     assert data_gauges[1] == 4 * data_gauges[0], data_gauges
 
 
+@pytest.mark.slow  # tier-1 budget triage: heavy full-training driver, runs in the slow tier
 def test_voting_ici_at_most_quarter_of_data_at_f256(rng):
     """Acceptance: at F=256, top_k=20 the voting learner moves <= 1/4 of
     the data-parallel learner's per-wave ICI bytes."""
@@ -235,6 +237,7 @@ def _driver_scores(cls, X, y, params, objective, rounds=5):
     return np.asarray(bst.predict(X, raw_score=True))
 
 
+@pytest.mark.slow  # tier-1 budget triage: heavy full-training driver, runs in the slow tier
 def test_voting_auc_within_1e3_of_exact(rng):
     n = 2000
     X = rng.randn(n, 40)

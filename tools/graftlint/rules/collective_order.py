@@ -209,7 +209,8 @@ class CollectiveOrderRule(Rule):
                    "process_index/rank-dependent branches, inside "
                    "rank-local-bound loops, or behind inconsistent axis "
                    "bindings")
-    scope_prefixes = ("parallel/", "treelearner/", "models/", "ops/")
+    scope_prefixes = ("parallel/", "treelearner/", "models/", "ops/",
+                      "streaming/")
     whole_program = True
 
     def check(self, pkg: Package) -> Iterable[Violation]:
